@@ -1,0 +1,32 @@
+"""raft_tpu — a TPU-native library of ML/data-science primitives.
+
+A from-scratch JAX/XLA/Pallas framework providing the capability surface of
+RAPIDS RAFT (reference: /root/reference, RAPIDS 22.06): dense & sparse linear
+algebra, pairwise distances, k-nearest-neighbors (brute-force + ANN),
+clustering, solvers, statistics, counter-based RNG, and a multi-chip
+communication layer over ICI/DCN via ``jax.sharding`` + ``shard_map``.
+
+Architecture is TPU-first, not a CUDA translation:
+
+* matmul-shaped work (expanded distances, kmeans update, PQ scoring) rides the
+  MXU via ``jax.lax.dot_general`` in bf16/f32;
+* non-GEMM metrics use tiled Pallas VPU kernels (``raft_tpu.ops``);
+* multi-device scaling uses a ``Mesh`` + XLA collectives (psum/all_gather/
+  ppermute) instead of NCCL/UCX (reference: cpp/include/raft/comms/);
+* the resource handle (reference: cpp/include/raft/core/handle.hpp) becomes a
+  light ``Resources`` object carrying device, mesh and compile options —
+  streams/cublas handles have no TPU analog; XLA owns scheduling.
+"""
+
+from raft_tpu.core.resources import Resources, DeviceResources, get_default_resources
+from raft_tpu.core import logger
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "get_default_resources",
+    "logger",
+    "__version__",
+]

@@ -1,0 +1,24 @@
+"""Core runtime: resources handle, logging, profiling ranges, interruptible.
+
+TPU-native analog of the reference core layer (cpp/include/raft/core/):
+``handle_t`` -> :class:`Resources`; spdlog logger -> :mod:`logger`;
+NVTX ranges -> :mod:`annotate` (jax.profiler traces); ``interruptible`` ->
+:mod:`interruptible` (cooperative cancellation of host loops).
+"""
+
+from raft_tpu.core.resources import Resources, DeviceResources, get_default_resources
+from raft_tpu.core import logger
+from raft_tpu.core.annotate import annotate, push_range, pop_range
+from raft_tpu.core.interruptible import Interruptible, InterruptedError as RaftInterruptedError
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "get_default_resources",
+    "logger",
+    "annotate",
+    "push_range",
+    "pop_range",
+    "Interruptible",
+    "RaftInterruptedError",
+]

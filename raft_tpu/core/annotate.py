@@ -1,0 +1,53 @@
+"""Profiler range annotations — analog of the reference NVTX layer.
+
+Reference: cpp/include/raft/core/nvtx.hpp:48-91 and
+common/detail/nvtx.hpp:23-206 (RAII ``nvtx::range``, push_range/pop_range,
+per-domain colored ranges, compiled out when NVTX disabled). The TPU analog
+uses ``jax.profiler``: ``TraceAnnotation`` shows up on the XLA trace viewer
+timeline and ``jax.named_scope`` tags HLO ops so ranges survive into compiled
+profiles. Disabled (near-zero cost) unless profiling is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, List
+
+import jax
+
+_stack: List[contextlib.ExitStack] = []
+
+
+@contextlib.contextmanager
+def annotate(name: str, *args) -> Iterator[None]:
+    """RAII-style range, usable as a decorator or context manager.
+
+    ``args`` are %-formatted into ``name`` like the reference's printf-style
+    range names (nvtx.hpp:54 ``range(const char* format, Args... args)``).
+    """
+    label = name % args if args else name
+    with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
+        yield
+
+
+def push_range(name: str, *args) -> None:
+    """Imperative begin (reference nvtx.hpp push_range)."""
+    label = name % args if args else name
+    es = contextlib.ExitStack()
+    es.enter_context(jax.profiler.TraceAnnotation(label))
+    _stack.append(es)
+
+
+def pop_range() -> None:
+    """Imperative end (reference nvtx.hpp pop_range)."""
+    if _stack:
+        _stack.pop().close()
+
+
+def start_trace(log_dir: str) -> None:
+    """Start an XLA profiler trace capture (output viewable in TensorBoard)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()

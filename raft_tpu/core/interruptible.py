@@ -1,0 +1,96 @@
+"""Cooperative cancellation — analog of ``raft::interruptible``.
+
+Reference: cpp/include/raft/core/interruptible.hpp:66-270. There, a per-thread
+token lets one thread cancel another's *stream wait*: ``synchronize(stream)``
+polls ``cudaStreamQuery`` in a yield loop checking the token, so CTRL+C can
+break out of a long GPU wait.
+
+On TPU under JAX there is no user-visible stream to poll, but the same need
+exists for long *host-side* algorithm loops (Lanczos restarts, kmeans
+iterations, IVF build batches): they should be cancellable from another
+thread or a signal handler without killing the process. This module provides
+the per-thread token registry + ``yield_now``/``cancel`` with identical
+semantics; device work already dispatched completes (as in the reference —
+cancellation is cooperative, not preemptive).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, Optional
+
+import jax
+
+
+class InterruptedError(RuntimeError):
+    """Raised inside the cancelled thread (reference: raft::interrupted_exception)."""
+
+
+class Interruptible:
+    """Per-thread cancellation token (reference interruptible.hpp:66)."""
+
+    _registry: "Dict[int, Interruptible]" = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._cancelled = threading.Event()
+
+    # -- token API -----------------------------------------------------------
+    def cancel(self) -> None:
+        """Request cancellation; the owner thread observes it at its next
+        ``yield_now`` (reference: cancel() sets the flag, :219)."""
+        self._cancelled.set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def clear(self) -> None:
+        self._cancelled.clear()
+
+    # -- static per-thread API ----------------------------------------------
+    @classmethod
+    def get_token(cls, thread_id: Optional[int] = None) -> "Interruptible":
+        """Get (creating if needed) the token for a thread
+        (reference interruptible.hpp:84 get_token())."""
+        tid = threading.get_ident() if thread_id is None else thread_id
+        with cls._registry_lock:
+            tok = cls._registry.get(tid)
+            if tok is None:
+                tok = cls()
+                cls._registry[tid] = tok
+            return tok
+
+    @classmethod
+    def yield_now(cls) -> None:
+        """Check this thread's token; raise if cancelled
+        (reference: yield() / yield_no_throw)."""
+        tok = cls.get_token()
+        if tok.cancelled():
+            tok.clear()
+            raise InterruptedError("raft_tpu: thread interrupted")
+
+    @classmethod
+    def yield_no_throw(cls) -> bool:
+        tok = cls.get_token()
+        if tok.cancelled():
+            tok.clear()
+            return False
+        return True
+
+    @classmethod
+    def cancel_thread(cls, thread_id: int) -> None:
+        cls.get_token(thread_id).cancel()
+
+    @classmethod
+    def synchronize(cls, x) -> None:
+        """Cancellable wait on a jax array / pytree.
+
+        Unlike the reference we cannot poll device completion at fine grain;
+        we check the token before and after blocking. For long host loops,
+        call :meth:`yield_now` between dispatches instead (same guidance as
+        the reference gives for compute-heavy loops).
+        """
+        cls.yield_now()
+        jax.block_until_ready(x)
+        cls.yield_now()

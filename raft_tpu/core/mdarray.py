@@ -1,0 +1,99 @@
+"""mdarray/mdspan analog — typed nd-array views + factories.
+
+Reference: cpp/include/raft/core/mdarray.hpp (owning ``mdarray``, non-owning
+``mdspan`` with ``row_major``/``col_major`` layouts and host/device accessor
+policies; factories ``make_device_matrix/vector/scalar`` further down the same
+file; storage policies in cpp/include/raft/detail/mdarray.hpp:142,195).
+
+On TPU, ``jax.Array`` already *is* an owning, device-resident nd array with
+XLA-managed layout, and numpy covers host arrays — so the useful residue is:
+
+* layout tags (XLA picks physical tiling; we track *logical* C/F order the way
+  the reference's pairwise APIs accept ``isRowMajor``);
+* factory helpers that allocate on the right device with the right dtype;
+* light validation helpers (``expect_matrix``/``expect_vector``) that the
+  algorithm layers use the way the reference uses static mdspan extents.
+
+Rather than wrap ``jax.Array`` in a class (which would fight every jnp
+function), layout is carried as a plain argument where it matters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# layout tags (reference mdarray.hpp:45-56)
+ROW_MAJOR = "row_major"
+COL_MAJOR = "col_major"
+
+
+def _device_of(res) -> Any:
+    from raft_tpu.core.resources import ensure_resources
+
+    return ensure_resources(res).device
+
+
+# -- owning factories (reference make_device_* / make_host_*) ----------------
+
+def make_device_matrix(res, n_rows: int, n_cols: int, dtype=jnp.float32) -> jax.Array:
+    return jax.device_put(jnp.zeros((n_rows, n_cols), dtype=dtype), _device_of(res))
+
+
+def make_device_vector(res, n: int, dtype=jnp.float32) -> jax.Array:
+    return jax.device_put(jnp.zeros((n,), dtype=dtype), _device_of(res))
+
+
+def make_device_scalar(res, value, dtype=None) -> jax.Array:
+    return jax.device_put(jnp.asarray(value, dtype=dtype), _device_of(res))
+
+
+def make_host_matrix(n_rows: int, n_cols: int, dtype=np.float32) -> np.ndarray:
+    return np.zeros((n_rows, n_cols), dtype=dtype)
+
+
+def make_host_vector(n: int, dtype=np.float32) -> np.ndarray:
+    return np.zeros((n,), dtype=dtype)
+
+
+# -- conversion (host_mdspan <-> device_mdspan analog) -----------------------
+
+def to_device(res, x) -> jax.Array:
+    return jax.device_put(jnp.asarray(x), _device_of(res))
+
+
+def to_host(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+# -- validation helpers (static-extent checks) -------------------------------
+
+def expect_matrix(x, name: str = "x") -> None:
+    if x.ndim != 2:
+        raise ValueError(f"{name}: expected a matrix (2d), got shape {x.shape}")
+
+
+def expect_vector(x, name: str = "x") -> None:
+    if x.ndim != 1:
+        raise ValueError(f"{name}: expected a vector (1d), got shape {x.shape}")
+
+
+def expect_same_dtype(*arrays) -> None:
+    dts = {np.dtype(a.dtype) for a in arrays}
+    if len(dts) > 1:
+        raise TypeError(f"dtype mismatch: {sorted(map(str, dts))}")
+
+
+def as_layout(x, layout: str) -> jax.Array:
+    """Return ``x`` with the given *logical* order.
+
+    XLA controls physical layout; a col-major logical matrix is represented as
+    its transpose flagged by the caller, matching how the reference passes
+    ``isRowMajor`` into kernels rather than reordering memory.
+    """
+    if layout not in (ROW_MAJOR, COL_MAJOR):
+        raise ValueError(f"unknown layout {layout}")
+    return jnp.asarray(x)

@@ -1,0 +1,126 @@
+"""Resource handle — TPU-native analog of ``raft::handle_t``.
+
+The reference handle (cpp/include/raft/core/handle.hpp:54-335) carries CUDA
+streams, a stream pool, lazily-created cuBLAS/cuSOLVER/cuSPARSE handles, device
+properties, and an injected communicator. On TPU, XLA owns scheduling and
+kernel libraries, so the handle reduces to:
+
+* the target device(s) and an optional ``jax.sharding.Mesh`` (the comms slot:
+  reference handle.hpp:239-264 ``set_comms``/``get_comms``);
+* compile/runtime policy: default float dtype, matmul precision, whether to
+  donate buffers;
+* a stream-pool analog: independent *dispatch lanes* are expressed simply as
+  separate ``jax.jit`` dispatches (async by default) — we keep an integer
+  ``n_lanes`` for API parity with ``get_stream_pool_size``.
+
+Everything is cheap, immutable-ish, and safe to share across algorithms, like
+the reference object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class Resources:
+    """Per-algorithm-invocation resource context.
+
+    Parameters mirror the semantics (not the fields) of ``raft::handle_t``.
+
+    Attributes
+    ----------
+    device : the primary jax device computations land on.
+    mesh : optional device mesh used by multi-chip algorithms; the analog of
+        the injected ``comms_t`` (reference core/handle.hpp:239).
+    sub_meshes : named sub-communicators, analog of
+        ``set_subcomm/get_subcomm`` (reference core/handle.hpp:252-262).
+    dtype : default floating dtype for algorithm internals.
+    matmul_precision : passed to ``jax.lax`` dot ops ("default" | "float32" |
+        "bfloat16_3x" ...). f32 accumulate on MXU is always used via
+        ``preferred_element_type``.
+    n_lanes : stream-pool-size analog (reference handle.hpp:158-237); used by
+        batched algorithms to decide how many independent dispatches to keep
+        in flight.
+    """
+
+    device: Any = None
+    mesh: Optional[jax.sharding.Mesh] = None
+    sub_meshes: dict = dataclasses.field(default_factory=dict)
+    dtype: Any = np.float32
+    matmul_precision: str = "highest"
+    n_lanes: int = 1
+
+    def __post_init__(self):
+        if self.device is None:
+            self.device = jax.devices()[0]
+
+    # -- comms slot ---------------------------------------------------------
+    def set_mesh(self, mesh: jax.sharding.Mesh) -> None:
+        """Inject the communicator (analog of handle.set_comms)."""
+        self.mesh = mesh
+
+    def get_mesh(self) -> jax.sharding.Mesh:
+        if self.mesh is None:
+            raise RuntimeError(
+                "No mesh set on Resources (analog of 'ERROR: communicator was not initialized')"
+            )
+        return self.mesh
+
+    @property
+    def has_mesh(self) -> bool:
+        return self.mesh is not None
+
+    def set_sub_mesh(self, key: str, mesh: jax.sharding.Mesh) -> None:
+        self.sub_meshes[key] = mesh
+
+    def get_sub_mesh(self, key: str) -> jax.sharding.Mesh:
+        return self.sub_meshes[key]
+
+    # -- stream-pool parity --------------------------------------------------
+    def get_n_lanes(self) -> int:
+        return max(1, int(self.n_lanes))
+
+    # -- device properties ---------------------------------------------------
+    def device_kind(self) -> str:
+        return getattr(self.device, "device_kind", "cpu")
+
+    def is_tpu(self) -> bool:
+        return getattr(self.device, "platform", "cpu") == "tpu"
+
+    def sync(self) -> None:
+        """Block until all outstanding async work on this device is done.
+
+        Analog of ``handle.sync_stream()``; jax arrays are async by default.
+        """
+        # effects barrier: a tiny transfer forces completion of prior work
+        jax.block_until_ready(jax.device_put(np.zeros((), np.int32), self.device))
+
+
+# Backwards-compatible alias mirroring raft 22.08's rename handle_t -> device_resources
+DeviceResources = Resources
+
+_default_lock = threading.Lock()
+_default_resources: Optional[Resources] = None
+
+
+def get_default_resources() -> Resources:
+    """Process-wide default handle (lazily created), for API convenience.
+
+    The reference requires an explicit handle everywhere; we accept ``None``
+    in public APIs and fall back to this.
+    """
+    global _default_resources
+    with _default_lock:
+        if _default_resources is None:
+            _default_resources = Resources()
+        return _default_resources
+
+
+def ensure_resources(res: Optional[Resources]) -> Resources:
+    return res if res is not None else get_default_resources()

@@ -1,0 +1,189 @@
+"""Decompositions & solvers — analog of raft/linalg {eig,svd,rsvd,qr,lstsq,
+cholesky_r1_update} (reference cpp/include/raft/linalg/detail/{eig,svd,rsvd,
+qr,lstsq,cholesky_r1_update}.cuh over cuSOLVER).
+
+XLA ships eigh/svd/qr natively (they run as HLO custom calls tuned per
+backend), so the cuSOLVER variants (DC vs Jacobi) collapse onto one
+implementation each; both names are kept so callers of the reference API land
+somewhere sensible. rsvd and the lstsq family are composed the same way the
+reference composes them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.linalg.gemm import gemm
+
+
+# -- symmetric eigen (reference linalg/detail/eig.cuh:32-231) ----------------
+
+def eig_dc(cov, n_eig_vals: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+    """Eigendecomposition of a symmetric matrix, ascending eigenvalues
+    (reference eigDC via cusolverDnsyevd). Returns (eig_vectors, eig_vals)
+    with vectors in columns."""
+    w, v = jnp.linalg.eigh(jnp.asarray(cov))
+    if n_eig_vals is not None:
+        w = w[:n_eig_vals]
+        v = v[:, :n_eig_vals]
+    return v, w
+
+
+def eig_jacobi(cov, tol: float = 1e-7, sweeps: int = 15):
+    """Jacobi-method variant (reference eigJacobi). XLA's eigh is used; tol
+    and sweeps are accepted for API parity."""
+    return eig_dc(cov)
+
+
+def eig_sel_dc(cov, n_eig_vals: int, largest: bool = True):
+    """Selective eigensolve (reference eigSelDC via syevdx): top/bottom
+    ``n_eig_vals`` pairs."""
+    w, v = jnp.linalg.eigh(jnp.asarray(cov))
+    if largest:
+        return v[:, -n_eig_vals:], w[-n_eig_vals:]
+    return v[:, :n_eig_vals], w[:n_eig_vals]
+
+
+# -- QR (reference linalg/detail/qr.cuh) -------------------------------------
+
+def qr_get_q(a) -> jax.Array:
+    q, _ = jnp.linalg.qr(jnp.asarray(a), mode="reduced")
+    return q
+
+
+def qr_get_qr(a) -> Tuple[jax.Array, jax.Array]:
+    return jnp.linalg.qr(jnp.asarray(a), mode="reduced")
+
+
+# -- SVD (reference linalg/detail/svd.cuh:39-171) ----------------------------
+
+def svd_qr(a, gen_left_vec: bool = True, gen_right_vec: bool = True):
+    """SVD via the dense path (reference svdQR over cusolverDngesvd).
+
+    Returns (u, s, v) where v holds right singular vectors in columns
+    (NOT v^T), matching the reference convention.
+    """
+    a = jnp.asarray(a)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return (u if gen_left_vec else None, s, vt.T if gen_right_vec else None)
+
+
+def svd_eig(a):
+    """SVD via eigendecomposition of the gram matrix (reference svdEig —
+    cheaper for tall-skinny a). Returns (u, s, v) with descending s."""
+    a = jnp.asarray(a)
+    g = gemm(a, a, trans_a=True)  # (n, n) gram
+    w, v = jnp.linalg.eigh(g)
+    # ascending -> descending
+    w = w[::-1]
+    v = v[:, ::-1]
+    s = jnp.sqrt(jnp.maximum(w, 0))
+    safe = jnp.where(s > 0, s, 1.0)
+    u = gemm(a, v) / safe[None, :]
+    return u, s, v
+
+
+def svd_jacobi(a, tol: float = 1e-7, sweeps: int = 15):
+    """Jacobi variant (reference svdJacobi via gesvdj); delegates to XLA svd."""
+    return svd_qr(a)
+
+
+def svd_reconstruction(u, s, v):
+    """u @ diag(s) @ v^T (reference svdReconstruction)."""
+    return gemm(jnp.asarray(u) * jnp.asarray(s)[None, :], v, trans_b=True)
+
+
+# -- randomized SVD (reference linalg/detail/rsvd.cuh:57,374) ----------------
+
+def rsvd_fixed_rank(a, k: int, p: int = 10, n_iters: int = 2, key=None,
+                    use_bbt: bool = False):
+    """Randomized SVD with oversampling ``p`` and ``n_iters`` subspace/power
+    iterations (reference rsvdFixedRank; QB decomposition + small dense SVD).
+
+    Returns (u[:, :k], s[:k], v[:, :k]).
+    """
+    a = jnp.asarray(a)
+    m, n = a.shape
+    l = min(k + p, n)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, (n, l), dtype=a.dtype)
+    y = gemm(a, omega)  # (m, l)
+    q = qr_get_q(y)
+    for _ in range(n_iters):
+        z = gemm(a, q, trans_a=True)   # (n, l)
+        q = qr_get_q(z)
+        y = gemm(a, q)                  # (m, l)
+        q = qr_get_q(y)
+    b = gemm(q, a, trans_a=True)        # (l, n)
+    ub, s, v = svd_qr(b)
+    u = gemm(q, ub)
+    return u[:, :k], s[:k], v[:, :k]
+
+
+def rsvd_perc(a, perc: float, p: int = 10, n_iters: int = 2, key=None):
+    """Rank chosen as a percentage of min(m,n) (reference rsvdPerc)."""
+    a = jnp.asarray(a)
+    k = max(1, int(perc * min(a.shape)))
+    return rsvd_fixed_rank(a, k, p=p, n_iters=n_iters, key=key)
+
+
+# -- least squares (reference linalg/detail/lstsq.cuh:120-355) ---------------
+
+def lstsq_svd_qr(a, b):
+    """minimize ||a w - b|| via SVD (reference lstsqSvdQR)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    safe = jnp.where(s > 1e-10 * s.max(), s, jnp.inf)
+    return (vt.T * (1.0 / safe)[None, :]) @ (u.T @ b)
+
+
+def lstsq_svd_jacobi(a, b):
+    return lstsq_svd_qr(a, b)
+
+
+def lstsq_eig(a, b):
+    """Via eigendecomposition of a^T a (reference lstsqEig)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    g = gemm(a, a, trans_a=True)
+    rhs = jnp.dot(a.T, b, precision="highest")
+    w, v = jnp.linalg.eigh(g)
+    safe = jnp.where(w > 1e-10 * jnp.maximum(w.max(), 1e-30), w, jnp.inf)
+    return v @ ((v.T @ rhs) / safe)
+
+
+def lstsq_qr(a, b):
+    """Via QR factorization (reference lstsqQR)."""
+    q, r = jnp.linalg.qr(jnp.asarray(a), mode="reduced")
+    return jax.scipy.linalg.solve_triangular(r, q.T @ jnp.asarray(b), lower=False)
+
+
+# -- Cholesky rank-1 update (reference linalg/detail/cholesky_r1_update.cuh) --
+
+def cholesky_rank1_update(l, n: int, lower: bool = True, eps: float = 0.0):
+    """Incremental Cholesky: given L for A[:n-1,:n-1] and A's new row/col
+    already written into ``l``'s last row (as in the reference's in-place
+    convention), return L for A[:n,:n].
+
+    Functional version: ``l`` is an (n, n) array whose [:n-1,:n-1] block is
+    the previous factor and whose last row (lower) holds A[n-1, :n].
+    """
+    l = jnp.asarray(l)
+    if not lower:
+        l = l.T
+    l_prev = l[: n - 1, : n - 1]
+    a_row = l[n - 1, : n - 1]
+    a_nn = l[n - 1, n - 1]
+    # solve L_prev y = a_row
+    y = jax.scipy.linalg.solve_triangular(l_prev, a_row, lower=True) if n > 1 else a_row
+    d = a_nn - jnp.dot(y, y)
+    d = jnp.maximum(d, eps) if eps > 0 else d
+    lnn = jnp.sqrt(d)
+    out = l.at[n - 1, : n - 1].set(y).at[n - 1, n - 1].set(lnn)
+    out = out.at[: n - 1, n - 1].set(0)
+    return out if lower else out.T

@@ -1,0 +1,126 @@
+"""Elementwise primitives — analog of raft/linalg {unary,binary,ternary}_op,
+map, eltwise, axpy (reference cpp/include/raft/linalg/detail/{map,unary_op,
+binary_op,ternary_op,eltwise,axpy}.cuh).
+
+These exist in the reference because every fusion must be hand-launched as a
+CUDA kernel with vectorized IO (TxN_t). Under XLA the compiler performs the
+fusion, so each function is a one-liner — kept as named functions so the
+algorithm layers (and downstream users of the reference API) have a stable
+surface, and so every op is trivially differentiable/vmappable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def unary_op(x, op: Callable):
+    """out[i] = op(x[i])  (reference linalg/unary_op.cuh:unaryOp)."""
+    return op(jnp.asarray(x))
+
+
+def binary_op(a, b, op: Callable):
+    """out[i] = op(a[i], b[i])  (reference linalg/binary_op.cuh)."""
+    return op(jnp.asarray(a), jnp.asarray(b))
+
+
+def ternary_op(a, b, c, op: Callable):
+    """out[i] = op(a[i], b[i], c[i])  (reference linalg/ternary_op.cuh)."""
+    return op(jnp.asarray(a), jnp.asarray(b), jnp.asarray(c))
+
+
+def map_op(op: Callable, *arrays):
+    """out[i] = op(x1[i], ..., xn[i])  (reference linalg/map.cuh:map)."""
+    return op(*[jnp.asarray(a) for a in arrays])
+
+
+def map_then_reduce(map_fn: Callable, *arrays, reduce_fn=jnp.sum, neutral=None):
+    """Fused map + full reduction (reference linalg/map_then_reduce.cuh).
+
+    ``neutral`` is accepted for API parity; XLA picks the identity itself.
+    """
+    mapped = map_fn(*[jnp.asarray(a) for a in arrays])
+    return reduce_fn(mapped)
+
+
+# -- arithmetic convenience (reference linalg/eltwise.cuh, add.cuh, ...) -----
+
+def add(a, b):
+    return jnp.asarray(a) + jnp.asarray(b)
+
+
+def add_scalar(x, scalar):
+    return jnp.asarray(x) + scalar
+
+
+def subtract(a, b):
+    return jnp.asarray(a) - jnp.asarray(b)
+
+
+def subtract_scalar(x, scalar):
+    return jnp.asarray(x) - scalar
+
+
+def multiply_scalar(x, scalar):
+    return jnp.asarray(x) * scalar
+
+
+def divide_scalar(x, scalar):
+    return jnp.asarray(x) / scalar
+
+
+def scalar_multiply(x, scalar):
+    return jnp.asarray(x) * scalar
+
+
+def eltwise_multiply(a, b):
+    return jnp.asarray(a) * jnp.asarray(b)
+
+
+def eltwise_divide(a, b):
+    return jnp.asarray(a) / jnp.asarray(b)
+
+
+# -- matrix math ops (reference matrix/math.cuh:41-319) ----------------------
+
+def power(x, scalar=None):
+    x = jnp.asarray(x)
+    return x * x if scalar is None else jnp.power(x, scalar)
+
+
+def sqrt(x):
+    return jnp.sqrt(jnp.asarray(x))
+
+
+def reciprocal(x, scalar=1.0, setzero: bool = False, thres: float = 1e-15):
+    """out = scalar / x, optionally zeroing small denominators
+    (reference matrix/math.cuh reciprocal w/ setzero)."""
+    x = jnp.asarray(x)
+    r = scalar / x
+    if setzero:
+        r = jnp.where(jnp.abs(x) <= thres, jnp.zeros_like(r), r)
+    return r
+
+
+def sign_flip(x):
+    """Flip sign of each *column* so its max-|.| element is positive
+    (reference matrix/math.cuh:signFlip, used by svd/pca determinism)."""
+    x = jnp.asarray(x)
+    idx = jnp.argmax(jnp.abs(x), axis=0)
+    signs = jnp.sign(x[idx, jnp.arange(x.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return x * signs[None, :]
+
+
+def axpy(alpha, x, y):
+    """y + alpha*x  (reference linalg/axpy.cuh over cublas)."""
+    return jnp.asarray(y) + alpha * jnp.asarray(x)
+
+
+def dot(x, y, precision="highest"):
+    """Vector dot product (cublasDot analog), f32 accumulation."""
+    x = jnp.asarray(x)
+    return jnp.dot(x, jnp.asarray(y), precision=precision,
+                   preferred_element_type=jnp.promote_types(x.dtype, jnp.float32))

@@ -1,0 +1,49 @@
+"""GEMM/GEMV — analog of raft/linalg/{gemm,gemv}.cuh over cuBLAS.
+
+On TPU these are ``lax.dot_general`` hitting the MXU; we keep the
+alpha/beta/trans surface of the reference API and force f32 accumulation via
+``preferred_element_type`` (bf16 inputs still accumulate in f32 on the MXU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _acc_dtype(*xs):
+    dt = jnp.result_type(*[jnp.asarray(x).dtype for x in xs])
+    return jnp.promote_types(dt, jnp.float32)
+
+
+def gemm(a, b, trans_a: bool = False, trans_b: bool = False,
+         alpha=1.0, beta=0.0, c=None, precision="highest"):
+    """alpha * op(a) @ op(b) + beta * c  (reference linalg/gemm.cuh)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = jnp.dot(a, b, precision=precision, preferred_element_type=_acc_dtype(a, b))
+    out = alpha * out
+    if c is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(c)
+    return out.astype(a.dtype)
+
+
+def gemv(a, x, trans_a: bool = False, alpha=1.0, beta=0.0, y=None,
+         precision="highest"):
+    """alpha * op(a) @ x + beta * y  (reference linalg/gemv.cuh)."""
+    a = jnp.asarray(a)
+    x = jnp.asarray(x)
+    if trans_a:
+        a = a.T
+    out = alpha * jnp.dot(a, x, precision=precision, preferred_element_type=_acc_dtype(a, x))
+    if y is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(y)
+    return out.astype(a.dtype)
+
+
+def transpose(a):
+    """Out-of-place transpose (reference linalg/transpose.cuh)."""
+    return jnp.asarray(a).T

@@ -1,0 +1,102 @@
+"""Lanczos eigensolver — analog of raft/linalg/detail/lanczos.cuh
+(reference computeSmallestEigenvectors:745 / computeLargestEigenvectors:1089;
+~1.4 kLoC of cublas spmv/dot/axpy orchestration).
+
+TPU-native design: the Lanczos recurrence is a ``lax.scan`` over a fixed
+Krylov width ``ncv`` with full reorthogonalization (a tall-skinny matmul —
+MXU work, cheaper and more robust on TPU than the reference's selective
+orthogonalization bookkeeping). The small (ncv x ncv) tridiagonal eigenproblem
+is solved with XLA ``eigh`` inside the same jit, so the whole solve is one
+compiled computation; restarting (the reference's memory optimization) is
+unnecessary because V fits easily in HBM at these sizes.
+
+``matvec`` may be any jit-compatible callable, e.g. a CSR/COO spmv from
+raft_tpu.sparse.linalg or a dense gemv — mirroring how the reference takes
+``sparse_matrix_t``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _lanczos_basis(matvec: Callable, n: int, ncv: int, v0, dtype):
+    """Run ncv Lanczos steps with full reorthogonalization.
+
+    Returns (V, alpha, beta): V is (ncv, n) rows = Lanczos vectors, alpha
+    (ncv,), beta (ncv,) with beta[j] = ||r_j|| linking v_j -> v_{j+1}.
+    """
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    def step(carry, j):
+        V, v_prev, v, beta_prev = carry
+        w = matvec(v)
+        alpha = jnp.dot(w, v)
+        w = w - alpha * v - beta_prev * v_prev
+        V = V.at[j].set(v)
+        # full reorthogonalization against v_0..v_j (two passes of classical
+        # Gram-Schmidt == MXU matmuls)
+        for _ in range(2):
+            coeffs = V @ w          # (ncv,)
+            w = w - V.T @ coeffs
+        beta = jnp.linalg.norm(w)
+        v_next = jnp.where(beta > 1e-30, w / jnp.where(beta > 1e-30, beta, 1.0),
+                           jnp.zeros_like(w))
+        return (V, v, v_next, beta), (alpha, beta)
+
+    V0 = jnp.zeros((ncv, n), dtype=dtype)
+    (V, _, _, _), (alphas, betas) = jax.lax.scan(
+        step, (V0, jnp.zeros(n, dtype), v0, jnp.asarray(0.0, dtype)),
+        jnp.arange(ncv))
+    return V, alphas, betas
+
+
+def _eig_from_basis(V, alphas, betas, n_components: int, smallest: bool):
+    ncv = alphas.shape[0]
+    T = (jnp.diag(alphas)
+         + jnp.diag(betas[:-1], 1)
+         + jnp.diag(betas[:-1], -1))
+    w, s = jnp.linalg.eigh(T)  # ascending
+    if smallest:
+        w_sel = w[:n_components]
+        s_sel = s[:, :n_components]
+    else:
+        w_sel = w[-n_components:][::-1]
+        s_sel = s[:, -n_components:][:, ::-1]
+    # Ritz vectors: (n, ncv) @ (ncv, k)
+    vecs = V.T @ s_sel
+    return w_sel, vecs
+
+
+def lanczos_solver(matvec: Callable, n: int, n_components: int,
+                   ncv: Optional[int] = None, max_iter: int = 0,
+                   tol: float = 1e-9, seed: int = 42, smallest: bool = True,
+                   v0=None, dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """Compute extreme eigenpairs of the symmetric operator ``matvec``.
+
+    Returns (eigenvalues (k,), eigenvectors (n, k)); eigenvalues ascending
+    for ``smallest``, descending otherwise — matching the reference outputs.
+    """
+    if ncv is None or ncv <= 0:
+        ncv = min(n, max(4 * n_components + 1, 32))
+    ncv = min(ncv, n)
+    if v0 is None:
+        v0 = jax.random.normal(jax.random.PRNGKey(seed), (n,), dtype=dtype)
+    else:
+        v0 = jnp.asarray(v0, dtype=dtype)
+    V, alphas, betas = _lanczos_basis(matvec, n, ncv, v0, dtype)
+    return _eig_from_basis(V, alphas, betas, n_components, smallest)
+
+
+def lanczos_smallest_eigenvectors(matvec, n, n_components, **kw):
+    """Reference lanczos.cuh:745 computeSmallestEigenvectors."""
+    return lanczos_solver(matvec, n, n_components, smallest=True, **kw)
+
+
+def lanczos_largest_eigenvectors(matvec, n, n_components, **kw):
+    """Reference lanczos.cuh:1089 computeLargestEigenvectors."""
+    return lanczos_solver(matvec, n, n_components, smallest=False, **kw)

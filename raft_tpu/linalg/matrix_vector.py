@@ -1,0 +1,55 @@
+"""Matrix-vector broadcasting ops — analog of raft/linalg/matrix_vector_op.cuh
+and matrix/linewise_op (reference cpp/include/raft/linalg/detail/
+matrix_vector_op.cuh, cpp/include/raft/matrix/detail/linewise_op.cuh).
+
+The reference needs vectorized row/col-broadcast kernels; XLA broadcasting
+covers it. ``along_rows=True`` means the vector spans the row dimension
+(length n_cols, broadcast to every row) matching the reference's
+``bcastAlongRows``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def matrix_vector_op(mat, vec, op: Callable, along_rows: bool = True):
+    """out[i,j] = op(mat[i,j], vec[j]) if along_rows else op(mat[i,j], vec[i]).
+
+    (reference linalg/matrix_vector_op.cuh:matrixVectorOp)
+    """
+    mat = jnp.asarray(mat)
+    vec = jnp.asarray(vec)
+    v = vec[None, :] if along_rows else vec[:, None]
+    return op(mat, v)
+
+
+def matrix_vector_binary(mat, vec1, vec2, op: Callable, along_rows: bool = True):
+    """Two-vector variant (used by mean/std normalization in the reference)."""
+    mat = jnp.asarray(mat)
+    v1 = jnp.asarray(vec1)
+    v2 = jnp.asarray(vec2)
+    if along_rows:
+        return op(mat, v1[None, :], v2[None, :])
+    return op(mat, v1[:, None], v2[:, None])
+
+
+def matrix_vector_add(mat, vec, along_rows: bool = True):
+    return matrix_vector_op(mat, vec, lambda m, v: m + v, along_rows)
+
+
+def matrix_vector_mul(mat, vec, along_rows: bool = True):
+    return matrix_vector_op(mat, vec, lambda m, v: m * v, along_rows)
+
+
+def linewise_op(mat, op: Callable, along_lines_rows: bool, *vecs):
+    """Apply op(mat_element, *vec_elements) line-wise
+    (reference matrix/detail/linewise_op.cuh:matrixLinewiseOp)."""
+    mat = jnp.asarray(mat)
+    if along_lines_rows:
+        vs = [jnp.asarray(v)[None, :] for v in vecs]
+    else:
+        vs = [jnp.asarray(v)[:, None] for v in vecs]
+    return op(mat, *vs)

@@ -1,0 +1,117 @@
+"""Reductions — analog of raft/linalg coalesced/strided reductions, norms,
+reduce_{rows,cols}_by_key (reference cpp/include/raft/linalg/detail/
+{reduce,coalesced_reduction,strided_reduction,norm,reduce_rows_by_key,
+reduce_cols_by_key,mean_squared_error,divide}.cuh).
+
+The reference distinguishes coalesced vs strided access patterns because CUDA
+memory coalescing demands different kernels; XLA handles layout, so both map
+to ``jnp`` reductions over the right axis. The *_by_key reductions become
+segment-sums, which on TPU we implement as one-hot matmuls when the number of
+keys is small (MXU-friendly) and ``jax.ops.segment_sum`` otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# norm type tags (reference linalg/norm.cuh NormType)
+L1Norm = "l1"
+L2Norm = "l2"
+LinfNorm = "linf"
+
+
+def reduce(x, axis: int, main_op: Callable = lambda v: v,
+           reduce_op=jnp.sum, final_op: Callable = lambda v: v, init=None):
+    """Generic fused reduce (reference linalg/reduce.cuh): per-element
+    ``main_op``, associative ``reduce_op`` over ``axis``, ``final_op`` on the
+    result. ``init`` accepted for parity; XLA supplies identities."""
+    x = jnp.asarray(x)
+    return final_op(reduce_op(main_op(x), axis=axis))
+
+
+def coalesced_reduction(x, main_op=lambda v: v, reduce_op=jnp.sum,
+                        final_op=lambda v: v):
+    """Reduce along the contiguous (last) axis — row-reduce for row-major
+    (reference linalg/coalesced_reduction.cuh)."""
+    return reduce(x, axis=-1, main_op=main_op, reduce_op=reduce_op, final_op=final_op)
+
+
+def strided_reduction(x, main_op=lambda v: v, reduce_op=jnp.sum,
+                      final_op=lambda v: v):
+    """Reduce along the strided (first) axis — column-reduce for row-major
+    (reference linalg/strided_reduction.cuh)."""
+    return reduce(x, axis=0, main_op=main_op, reduce_op=reduce_op, final_op=final_op)
+
+
+def norm(x, norm_type: str = L2Norm, axis: int = -1, do_sqrt: bool = False):
+    """Row/col norms (reference linalg/norm.cuh rowNorm/colNorm).
+
+    Note: as in the reference, L2 without ``do_sqrt`` returns the *squared*
+    norm — that is what the expanded-distance trick consumes.
+    """
+    x = jnp.asarray(x)
+    if norm_type == L1Norm:
+        return jnp.sum(jnp.abs(x), axis=axis)
+    if norm_type == L2Norm:
+        sq = jnp.sum(x * x, axis=axis)
+        return jnp.sqrt(sq) if do_sqrt else sq
+    if norm_type == LinfNorm:
+        return jnp.max(jnp.abs(x), axis=axis)
+    raise ValueError(f"unknown norm type {norm_type}")
+
+
+def row_norm(x, norm_type: str = L2Norm, do_sqrt: bool = False):
+    return norm(x, norm_type, axis=-1, do_sqrt=do_sqrt)
+
+
+def col_norm(x, norm_type: str = L2Norm, do_sqrt: bool = False):
+    return norm(x, norm_type, axis=0, do_sqrt=do_sqrt)
+
+
+def reduce_rows_by_key(x, keys, n_keys: int, weights=None):
+    """sums[key, :] += w * x[row, :] (reference linalg/reduce_rows_by_key.cuh).
+
+    TPU-native: one-hot matmul — (n_keys, n) @ (n, d) rides the MXU, which is
+    how kmeans centroid accumulation stays dense and fast. Falls back to
+    segment_sum for very large n_keys where the one-hot would dominate flops.
+    """
+    x = jnp.asarray(x)
+    keys = jnp.asarray(keys)
+    if weights is not None:
+        x = x * jnp.asarray(weights)[:, None]
+    if n_keys <= 4096:
+        onehot = jax.nn.one_hot(keys, n_keys, dtype=x.dtype)
+        return jnp.dot(onehot.T, x, precision="highest",
+                       preferred_element_type=jnp.promote_types(x.dtype, jnp.float32)).astype(x.dtype)
+    return jax.ops.segment_sum(x, keys, num_segments=n_keys)
+
+
+def reduce_cols_by_key(x, keys, n_keys: int):
+    """out[i, key] += x[i, col] per column key (reference
+    linalg/reduce_cols_by_key.cuh)."""
+    x = jnp.asarray(x)
+    keys = jnp.asarray(keys)
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=x.dtype)  # (d, n_keys)
+    return jnp.dot(x, onehot, precision="highest",
+                   preferred_element_type=jnp.promote_types(x.dtype, jnp.float32)).astype(x.dtype)
+
+
+def mean_squared_error(a, b, weight: float = 1.0):
+    """weight * mean((a-b)^2)  (reference linalg/mean_squared_error.cuh)."""
+    a = jnp.asarray(a)
+    d = a - jnp.asarray(b)
+    return weight * jnp.mean(d * d)
+
+
+def binary_div_skip_zero(a, b, return_zero: bool = False):
+    """a / b skipping zero denominators (reference linalg/divide.cuh /
+    matrix ops used by centroid division)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    zero = b == 0
+    safe = jnp.where(zero, jnp.ones_like(b), b)
+    out = a / safe
+    return jnp.where(zero, jnp.zeros_like(out) if return_zero else a, out)

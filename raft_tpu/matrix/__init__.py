@@ -1,0 +1,108 @@
+"""Matrix utilities — analog of raft/matrix (reference
+cpp/include/raft/matrix/{matrix,math,col_wise_sort}.cuh, ~2.8 kLoC).
+
+Slicing/gather/reverse/argmax/diagonal/triangular ops as XLA compositions;
+column-wise sort via ``jnp.sort``/``argsort`` (XLA's sort is the TPU-tuned
+primitive the reference builds with cub segmented radix sort).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def copy_rows(x, indices):
+    """Gather rows (reference matrix.cuh:copyRows)."""
+    return jnp.take(jnp.asarray(x), jnp.asarray(indices), axis=0)
+
+
+def slice_matrix(x, x1: int, y1: int, x2: int, y2: int):
+    """out = x[x1:x2, y1:y2] (reference matrix.cuh:sliceMatrix)."""
+    return jnp.asarray(x)[x1:x2, y1:y2]
+
+
+def truncate_zero_origin(x, n_rows: int, n_cols: int):
+    return jnp.asarray(x)[:n_rows, :n_cols]
+
+
+def col_reverse(x):
+    """Reverse column order (reference matrix.cuh:colReverse)."""
+    return jnp.asarray(x)[:, ::-1]
+
+
+def row_reverse(x):
+    """Reverse row order (reference matrix.cuh:rowReverse)."""
+    return jnp.asarray(x)[::-1, :]
+
+
+def get_diagonal(x):
+    """Extract diagonal (reference matrix.cuh:getDiagonalMatrix)."""
+    return jnp.diagonal(jnp.asarray(x))
+
+
+def set_diagonal(x, vec):
+    x = jnp.asarray(x)
+    n = min(x.shape)
+    return x.at[jnp.arange(n), jnp.arange(n)].set(jnp.asarray(vec)[:n])
+
+
+def invert_diagonal(x):
+    """In-place 1/diag (reference matrix.cuh:invertDiagonalMatrix)."""
+    x = jnp.asarray(x)
+    n = min(x.shape)
+    idx = jnp.arange(n)
+    return x.at[idx, idx].set(1.0 / x[idx, idx])
+
+
+def argmax(x, axis: int = 1):
+    """Arg-max per row (axis=1) or per column (axis=0)
+    (reference matrix.cuh:argmax computes one index per data row)."""
+    return jnp.argmax(jnp.asarray(x), axis=axis)
+
+
+def argmin(x, axis: int = 1):
+    return jnp.argmin(jnp.asarray(x), axis=axis)
+
+
+def copy_upper_triangular(x):
+    """Copy strict upper triangle into a vector-packed form is not needed;
+    the reference (matrix.cuh:copyUpperTriangular) writes U into a k x k
+    matrix — here we just return triu."""
+    return jnp.triu(jnp.asarray(x))
+
+
+def ratio(x, axis: Optional[int] = None):
+    """x / sum(x) (reference math.cuh:ratio)."""
+    x = jnp.asarray(x)
+    return x / jnp.sum(x, axis=axis, keepdims=axis is not None)
+
+
+def seq_root(x, scalar: float = 1.0, set_neg_zero: bool = False):
+    """sqrt(scalar * x), optionally clamping negatives to 0
+    (reference math.cuh:seqRoot)."""
+    x = jnp.asarray(x) * scalar
+    if set_neg_zero:
+        x = jnp.maximum(x, 0)
+    return jnp.sqrt(x)
+
+
+def zero_small_values(x, thres: float = 1e-15):
+    """Set |x| <= thres to zero (reference math.cuh:setSmallValuesZero)."""
+    x = jnp.asarray(x)
+    return jnp.where(jnp.abs(x) <= thres, jnp.zeros_like(x), x)
+
+
+def sort_cols_per_row(x, ascending: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Sort each row's values, returning (sorted, source-column indices)
+    (reference matrix/col_wise_sort.cuh:sort_cols_per_row)."""
+    x = jnp.asarray(x)
+    if not ascending:
+        x = -x
+    idx = jnp.argsort(x, axis=1, stable=True)
+    sorted_vals = jnp.take_along_axis(x, idx, axis=1)
+    if not ascending:
+        sorted_vals = -sorted_vals
+    return sorted_vals, idx

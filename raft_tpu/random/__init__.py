@@ -1,0 +1,40 @@
+"""RNG subsystem + dataset generators — analog of raft/random (reference
+cpp/include/raft/random/, ~3.9 kLoC: Philox/PCG counter-based device
+generators, distribution kernels, make_blobs/make_regression/
+multi_variable_gaussian/permute/sample_without_replacement).
+
+TPU-native: JAX's threefry is already a counter-based, reproducible,
+parallel-safe generator — the same design point as the reference's Philox
+(random/detail/rng_device.cuh:437). :class:`RngState` wraps seed +
+subsequence management with the reference's name; distributions are jittable
+functions of (state, shape).
+"""
+
+from raft_tpu.random.rng import (
+    RngState,
+    GenPhilox,
+    GenPC,
+    uniform,
+    uniform_int,
+    normal,
+    normal_int,
+    normal_table,
+    fill,
+    bernoulli,
+    scaled_bernoulli,
+    gumbel,
+    lognormal,
+    logistic,
+    exponential,
+    rayleigh,
+    laplace,
+    discrete,
+    custom_distribution,
+    sample_without_replacement,
+    permute,
+)
+from raft_tpu.random.make_blobs import make_blobs
+from raft_tpu.random.make_regression import make_regression
+from raft_tpu.random.multi_variable_gaussian import multi_variable_gaussian
+
+__all__ = [k for k in dir() if not k.startswith("_")]

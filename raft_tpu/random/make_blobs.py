@@ -1,0 +1,51 @@
+"""make_blobs — isotropic Gaussian blob generator.
+
+Reference: cpp/include/raft/random/make_blobs.cuh:63,126 and
+random/detail/make_blobs.cuh (GMM blobs: uniform or given centers, per-blob
+or global std, optional shuffle; returns data + integer labels).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import RngState, _key_of
+
+
+def make_blobs(n_samples: int, n_features: int, n_clusters: int = 5,
+               state: Optional[RngState] = None,
+               centers=None, cluster_std: Union[float, jax.Array] = 1.0,
+               center_box: Tuple[float, float] = (-10.0, 10.0),
+               shuffle: bool = True, dtype=jnp.float32):
+    """Generate (data (n_samples, n_features), labels (n_samples,)).
+
+    Matches the reference's semantics: centers drawn uniform in
+    ``center_box`` when not given; ``cluster_std`` scalar or per-cluster
+    vector; samples assigned round-robin then shuffled.
+    """
+    if state is None:
+        state = RngState(0)
+    key = _key_of(state)
+    k_centers, k_noise, k_shuffle = jax.random.split(key, 3)
+
+    if centers is None:
+        centers = jax.random.uniform(
+            k_centers, (n_clusters, n_features), dtype=dtype,
+            minval=center_box[0], maxval=center_box[1])
+    else:
+        centers = jnp.asarray(centers, dtype=dtype)
+        n_clusters = centers.shape[0]
+
+    std = jnp.broadcast_to(jnp.asarray(cluster_std, dtype=dtype), (n_clusters,))
+
+    # round-robin labels like the reference's even partitioning
+    labels = jnp.arange(n_samples, dtype=jnp.int32) % n_clusters
+    if shuffle:
+        labels = jax.random.permutation(k_shuffle, labels)
+
+    noise = jax.random.normal(k_noise, (n_samples, n_features), dtype=dtype)
+    data = centers[labels] + noise * std[labels][:, None]
+    return data, labels

@@ -1,0 +1,70 @@
+"""make_regression — random linear-model dataset.
+
+Reference: cpp/include/raft/random/make_regression.cuh +
+detail/make_regression.cuh (gaussian X, optional low effective rank via an
+SVD-shaped spectrum, n_informative coefficients, bias, noise, shuffle;
+returns X, y and optionally the ground-truth coefficients).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import RngState, _key_of
+
+
+def _low_rank_matrix(key, n_samples, n_features, effective_rank, tail_strength, dtype):
+    # singular profile: bell-shaped low-rank + exponentially decaying tail
+    # (same construction as the reference / sklearn)
+    n = min(n_samples, n_features)
+    k1, k2 = jax.random.split(key)
+    u, _ = jnp.linalg.qr(jax.random.normal(k1, (n_samples, n), dtype=dtype))
+    v, _ = jnp.linalg.qr(jax.random.normal(k2, (n_features, n), dtype=dtype))
+    sing_ind = jnp.arange(n, dtype=dtype) / effective_rank
+    low_rank = (1 - tail_strength) * jnp.exp(-(sing_ind ** 2))
+    tail = tail_strength * jnp.exp(-0.1 * sing_ind)
+    s = low_rank + tail
+    return (u * s[None, :]) @ v.T
+
+
+def make_regression(n_samples: int, n_features: int, n_informative: int,
+                    state: Optional[RngState] = None, n_targets: int = 1,
+                    bias: float = 0.0, effective_rank: Optional[int] = None,
+                    tail_strength: float = 0.5, noise: float = 0.0,
+                    shuffle: bool = True, coef: bool = False,
+                    dtype=jnp.float32):
+    """Returns (X, y[, w]) with y = X @ w + bias + noise·N(0,1)."""
+    if state is None:
+        state = RngState(0)
+    key = _key_of(state)
+    kx, kw, kn, ks, kc = jax.random.split(key, 5)
+
+    if effective_rank is None:
+        x = jax.random.normal(kx, (n_samples, n_features), dtype=dtype)
+    else:
+        x = _low_rank_matrix(kx, n_samples, n_features, effective_rank,
+                             tail_strength, dtype)
+
+    n_informative = min(n_informative, n_features)
+    w = jnp.zeros((n_features, n_targets), dtype=dtype)
+    w_inf = 100.0 * jax.random.uniform(kw, (n_informative, n_targets), dtype=dtype)
+    w = w.at[:n_informative].set(w_inf)
+
+    y = x @ w + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, y.shape, dtype=dtype)
+
+    if shuffle:
+        row_perm = jax.random.permutation(ks, n_samples)
+        col_perm = jax.random.permutation(kc, n_features)
+        x = x[row_perm][:, col_perm]
+        y = y[row_perm]
+        w = w[col_perm]
+
+    y = y[:, 0] if n_targets == 1 else y
+    if coef:
+        return x, y, (w[:, 0] if n_targets == 1 else w)
+    return x, y

@@ -1,0 +1,33 @@
+"""multi_variable_gaussian — correlated normal draws.
+
+Reference: cpp/include/raft/random/multi_variable_gaussian.cuh (cuSOLVER
+potrf/syevd of the covariance + gemm with standard normals). TPU analog:
+XLA cholesky (or eigh fallback for PSD-but-singular covariances) + MXU gemm.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import RngState, _key_of
+
+
+def multi_variable_gaussian(state, n_points: int, mu, cov,
+                            method: str = "cholesky", dtype=jnp.float32):
+    """Draw ``n_points`` samples from N(mu, cov); returns (dim, n_points)
+    column-per-sample like the reference."""
+    if state is None:
+        state = RngState(0)
+    mu = jnp.asarray(mu, dtype=dtype)
+    cov = jnp.asarray(cov, dtype=dtype)
+    dim = mu.shape[0]
+    z = jax.random.normal(_key_of(state), (dim, n_points), dtype=dtype)
+    if method == "cholesky":
+        l = jnp.linalg.cholesky(cov)
+    else:  # "jacobi"/"qr" in the reference -> eigh-based PSD square root
+        w, v = jnp.linalg.eigh(cov)
+        l = v * jnp.sqrt(jnp.maximum(w, 0.0))[None, :]
+    return mu[:, None] + l @ z

@@ -1,0 +1,164 @@
+"""Counter-based RNG + distributions.
+
+Reference: cpp/include/raft/random/rng_state.hpp:26-50 (RngState: seed +
+base_subsequence + generator type), rng.cuh:39-368 (distribution entry
+points), detail/rng_device.cuh (PhiloxGenerator:437, PCGenerator:535).
+
+JAX's threefry serves as the counter-based generator; ``RngState`` carries
+(seed, subsequence) and each draw uses ``jax.random.fold_in`` so repeated
+calls advance deterministically, mirroring ``advance(subsequence)`` in the
+reference. All distribution functions are pure given the state and are safe
+inside jit/vmap/shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# generator type tags (reference rng_state.hpp GeneratorType)
+GenPhilox = "philox"
+GenPC = "pc"
+
+
+@dataclasses.dataclass
+class RngState:
+    """Host-side RNG state (reference random/rng_state.hpp)."""
+
+    seed: int = 0
+    base_subsequence: int = 0
+    type: str = GenPhilox
+
+    def advance(self, n: int = 1) -> None:
+        """Skip ahead (reference RngState::advance)."""
+        self.base_subsequence += n
+
+    def key(self, advance: bool = True) -> jax.Array:
+        """Derive the jax PRNG key for the current subsequence and advance."""
+        k = jax.random.fold_in(jax.random.PRNGKey(self.seed), self.base_subsequence)
+        if advance:
+            self.base_subsequence += 1
+        return k
+
+
+def _key_of(state) -> jax.Array:
+    if isinstance(state, RngState):
+        return state.key()
+    return state  # already a jax key
+
+
+# -- distributions (reference rng.cuh:39-368) --------------------------------
+
+def uniform(state, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_key_of(state), shape, dtype=dtype, minval=low, maxval=high)
+
+
+def uniform_int(state, shape, low, high, dtype=jnp.int32):
+    return jax.random.randint(_key_of(state), shape, low, high, dtype=dtype)
+
+
+def normal(state, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_key_of(state), shape, dtype=dtype)
+
+
+def normal_int(state, shape, mu, sigma, dtype=jnp.int32):
+    return jnp.rint(normal(state, shape, mu, sigma)).astype(dtype)
+
+
+def normal_table(state, n_rows: int, mu_vec, sigma_vec, dtype=jnp.float32):
+    """Per-column (mu, sigma) normal draws (reference rng.cuh:normalTable)."""
+    mu_vec = jnp.asarray(mu_vec, dtype=dtype)
+    sigma_vec = jnp.asarray(sigma_vec, dtype=dtype)
+    z = jax.random.normal(_key_of(state), (n_rows, mu_vec.shape[0]), dtype=dtype)
+    return mu_vec[None, :] + sigma_vec[None, :] * z
+
+
+def fill(state, shape, val, dtype=jnp.float32):
+    return jnp.full(shape, val, dtype=dtype)
+
+
+def bernoulli(state, shape, prob, dtype=jnp.bool_):
+    return jax.random.bernoulli(_key_of(state), prob, shape).astype(dtype)
+
+
+def scaled_bernoulli(state, shape, prob, scale, dtype=jnp.float32):
+    """+-scale with P(positive)=1-prob (reference scaled_bernoulli)."""
+    b = jax.random.bernoulli(_key_of(state), prob, shape)
+    return jnp.where(b, -scale, scale).astype(dtype)
+
+
+def gumbel(state, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(_key_of(state), shape, dtype=dtype)
+
+
+def lognormal(state, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(state, shape, mu, sigma, dtype))
+
+
+def logistic(state, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.logistic(_key_of(state), shape, dtype=dtype)
+
+
+def exponential(state, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_key_of(state), shape, dtype=dtype) / lam
+
+
+def rayleigh(state, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_key_of(state), shape, dtype=dtype, minval=1e-12, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def laplace(state, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return jax.random.laplace(_key_of(state), shape, dtype=dtype) * scale + mu
+
+
+def discrete(state, shape, probs, dtype=jnp.int32):
+    """Sample indices ~ probs (reference rng.cuh:discrete)."""
+    probs = jnp.asarray(probs)
+    return jax.random.categorical(_key_of(state), jnp.log(jnp.maximum(probs, 1e-38)),
+                                  shape=shape).astype(dtype)
+
+
+def custom_distribution(state, shape, inv_cdf: Callable, dtype=jnp.float32):
+    """Inverse-CDF sampling (reference custom_distribution takes a device
+    lambda mapping U(0,1) draws through a user CDF inverse)."""
+    u = jax.random.uniform(_key_of(state), shape, dtype=dtype)
+    return inv_cdf(u)
+
+
+# -- sampling / permutation ---------------------------------------------------
+
+def sample_without_replacement(state, n_samples: int, pool_size: int,
+                               weights=None) -> Tuple[jax.Array, jax.Array]:
+    """Weighted sampling w/o replacement (reference rng.cuh:369
+    sampleWithoutReplacement).
+
+    TPU-native: Gumbel-top-k — perturb log-weights with Gumbel noise and take
+    the top ``n_samples``; one fused sort instead of the reference's
+    rejection loop. Returns (out_indices, out_weights-of-selected).
+    """
+    key = _key_of(state)
+    if weights is None:
+        logw = jnp.zeros((pool_size,), jnp.float32)
+        w = jnp.ones((pool_size,), jnp.float32)
+    else:
+        w = jnp.asarray(weights, jnp.float32)
+        logw = jnp.log(jnp.maximum(w, 1e-38))
+    g = jax.random.gumbel(key, (pool_size,), dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logw + g, n_samples)
+    return idx, w[idx]
+
+
+def permute(state, n: int, x=None, row_major: bool = True):
+    """Random permutation; optionally gather rows of ``x`` by it
+    (reference rng.cuh / detail/permute.cuh: returns perms and permuted copy).
+    """
+    perm = jax.random.permutation(_key_of(state), n)
+    if x is None:
+        return perm, None
+    x = jnp.asarray(x)
+    out = jnp.take(x, perm, axis=0 if row_major else -1)
+    return perm, out

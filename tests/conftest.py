@@ -1,0 +1,39 @@
+"""Test config: run all tests on CPU with 8 virtual devices.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-device tests run
+against a virtual mesh the way pyraft's Dask tests use a multi-process
+single-node cluster (python/raft/raft/test/conftest.py in the reference).
+Env vars must be set before jax initializes.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax.sharding
+
+    devs = np.array(jax.devices()[:8])
+    return jax.sharding.Mesh(devs, ("x",))
+
+
+@pytest.fixture()
+def rng_np():
+    return np.random.default_rng(42)

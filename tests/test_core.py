@@ -1,0 +1,141 @@
+"""Core runtime tests (analog of reference cpp/test/{handle.cpp,logger.cpp,
+interruptible.cu,mdarray.cu})."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.core import logger, mdarray
+from raft_tpu.core.interruptible import Interruptible, InterruptedError
+from raft_tpu.core.annotate import annotate, push_range, pop_range
+from raft_tpu.core.resources import Resources
+
+
+class TestResources:
+    def test_default(self):
+        res = Resources()
+        assert res.device is not None
+        assert not res.has_mesh
+
+    def test_mesh_slot(self, mesh8):
+        res = Resources()
+        res.set_mesh(mesh8)
+        assert res.get_mesh() is mesh8
+        res.set_sub_mesh("sub", mesh8)
+        assert res.get_sub_mesh("sub") is mesh8
+
+    def test_no_mesh_raises(self):
+        with pytest.raises(RuntimeError):
+            Resources().get_mesh()
+
+    def test_sync(self):
+        Resources().sync()
+
+    def test_default_singleton(self):
+        assert raft_tpu.get_default_resources() is raft_tpu.get_default_resources()
+
+
+class TestLogger:
+    def test_levels_and_callback(self):
+        captured = []
+        logger.set_callback(lambda lvl, msg: captured.append(msg))
+        logger.set_level(logger.INFO)
+        logger.info("hello %d", 42)
+        logger.debug("not captured")
+        assert any("hello 42" in m for m in captured)
+        assert not any("not captured" in m for m in captured)
+        logger.set_level(logger.DEBUG)
+        logger.debug("now captured")
+        assert any("now captured" in m for m in captured)
+        logger.set_callback(None)
+
+    def test_should_log_for(self):
+        logger.set_level(logger.WARN)
+        assert logger.should_log_for(logger.ERROR)
+        assert not logger.should_log_for(logger.INFO)
+        logger.set_level(logger.INFO)
+
+    def test_flush_callback(self):
+        flushed = []
+        logger.set_flush(lambda: flushed.append(1))
+        logger.flush()
+        assert flushed
+        logger.set_flush(None)
+
+
+class TestInterruptible:
+    def test_yield_no_cancel(self):
+        Interruptible.yield_now()  # should not raise
+
+    def test_cancel_self(self):
+        Interruptible.get_token().cancel()
+        with pytest.raises(InterruptedError):
+            Interruptible.yield_now()
+        # token cleared after raising
+        Interruptible.yield_now()
+
+    def test_cancel_other_thread(self):
+        errors = []
+        started = threading.Event()
+        tid_holder = []
+
+        def worker():
+            tid_holder.append(threading.get_ident())
+            Interruptible.get_token()
+            started.set()
+            for _ in range(200):
+                try:
+                    Interruptible.yield_now()
+                except InterruptedError:
+                    errors.append("interrupted")
+                    return
+                time.sleep(0.005)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        started.wait()
+        Interruptible.cancel_thread(tid_holder[0])
+        t.join(timeout=5)
+        assert errors == ["interrupted"]
+
+    def test_synchronize(self):
+        x = jax.numpy.ones((8,))
+        Interruptible.synchronize(x)
+
+
+class TestAnnotate:
+    def test_context(self):
+        with annotate("test %d", 1):
+            pass
+
+    def test_push_pop(self):
+        push_range("r")
+        pop_range()
+        pop_range()  # extra pop is a no-op
+
+
+class TestMdarray:
+    def test_factories(self):
+        m = mdarray.make_device_matrix(None, 4, 5)
+        assert m.shape == (4, 5)
+        v = mdarray.make_device_vector(None, 7, dtype=np.int32)
+        assert v.shape == (7,) and v.dtype == np.int32
+        s = mdarray.make_device_scalar(None, 3.5)
+        assert float(s) == 3.5
+
+    def test_round_trip(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        d = mdarray.to_device(None, x)
+        np.testing.assert_array_equal(mdarray.to_host(d), x)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            mdarray.expect_matrix(np.zeros(3))
+        with pytest.raises(ValueError):
+            mdarray.expect_vector(np.zeros((3, 3)))
+        with pytest.raises(TypeError):
+            mdarray.expect_same_dtype(np.zeros(2, np.float32), np.zeros(2, np.float64))

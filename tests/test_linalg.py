@@ -1,0 +1,209 @@
+"""linalg tests vs numpy oracles (analog of reference cpp/test/linalg/*)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.linalg import matrix_vector as mv
+
+
+@pytest.fixture
+def mats(rng_np):
+    a = rng_np.standard_normal((17, 9)).astype(np.float32)
+    b = rng_np.standard_normal((17, 9)).astype(np.float32)
+    return a, b
+
+
+class TestElementwise:
+    def test_basic_ops(self, mats):
+        a, b = mats
+        np.testing.assert_allclose(linalg.add(a, b), a + b, rtol=1e-6)
+        np.testing.assert_allclose(linalg.subtract(a, b), a - b, rtol=1e-6)
+        np.testing.assert_allclose(linalg.eltwise_multiply(a, b), a * b, rtol=1e-6)
+        np.testing.assert_allclose(linalg.add_scalar(a, 2.0), a + 2, rtol=1e-6)
+        np.testing.assert_allclose(linalg.multiply_scalar(a, 3.0), a * 3, rtol=1e-6)
+
+    def test_map_then_reduce(self, mats):
+        a, b = mats
+        got = linalg.map_then_reduce(lambda x, y: (x - y) ** 2, a, b)
+        np.testing.assert_allclose(got, ((a - b) ** 2).sum(), rtol=1e-4)
+
+    def test_axpy_dot(self, rng_np):
+        x = rng_np.standard_normal(33).astype(np.float32)
+        y = rng_np.standard_normal(33).astype(np.float32)
+        np.testing.assert_allclose(linalg.axpy(2.0, x, y), y + 2 * x, rtol=1e-6)
+        np.testing.assert_allclose(linalg.dot(x, y), np.dot(x, y), rtol=1e-5)
+
+    def test_sign_flip(self, mats):
+        a, _ = mats
+        f = np.asarray(linalg.sign_flip(a))
+        idx = np.abs(f).argmax(axis=0)
+        assert (f[idx, np.arange(f.shape[1])] >= 0).all()
+
+    def test_reciprocal_setzero(self):
+        x = np.array([2.0, 0.0, 4.0], np.float32)
+        got = np.asarray(linalg.reciprocal(x, scalar=1.0, setzero=True))
+        np.testing.assert_allclose(got, [0.5, 0.0, 0.25])
+
+
+class TestReduction:
+    def test_norms(self, mats):
+        a, _ = mats
+        np.testing.assert_allclose(linalg.row_norm(a, linalg.L2Norm),
+                                   (a ** 2).sum(1), rtol=1e-5)
+        np.testing.assert_allclose(linalg.row_norm(a, linalg.L2Norm, do_sqrt=True),
+                                   np.linalg.norm(a, axis=1), rtol=1e-5)
+        np.testing.assert_allclose(linalg.col_norm(a, linalg.L1Norm),
+                                   np.abs(a).sum(0), rtol=1e-5)
+        np.testing.assert_allclose(linalg.row_norm(a, linalg.LinfNorm),
+                                   np.abs(a).max(1), rtol=1e-6)
+
+    def test_coalesced_strided(self, mats):
+        a, _ = mats
+        np.testing.assert_allclose(linalg.coalesced_reduction(a), a.sum(1), rtol=1e-4)
+        np.testing.assert_allclose(linalg.strided_reduction(a), a.sum(0), rtol=1e-4)
+
+    def test_reduce_rows_by_key(self, rng_np):
+        x = rng_np.standard_normal((50, 7)).astype(np.float32)
+        keys = rng_np.integers(0, 5, 50).astype(np.int32)
+        got = np.asarray(linalg.reduce_rows_by_key(x, keys, 5))
+        want = np.zeros((5, 7), np.float32)
+        for i, k in enumerate(keys):
+            want[k] += x[i]
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_reduce_rows_by_key_weighted(self, rng_np):
+        x = rng_np.standard_normal((30, 4)).astype(np.float32)
+        keys = rng_np.integers(0, 3, 30).astype(np.int32)
+        w = rng_np.random(30).astype(np.float32)
+        got = np.asarray(linalg.reduce_rows_by_key(x, keys, 3, weights=w))
+        want = np.zeros((3, 4), np.float32)
+        for i, k in enumerate(keys):
+            want[k] += w[i] * x[i]
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_reduce_cols_by_key(self, rng_np):
+        x = rng_np.standard_normal((6, 20)).astype(np.float32)
+        keys = rng_np.integers(0, 4, 20).astype(np.int32)
+        got = np.asarray(linalg.reduce_cols_by_key(x, keys, 4))
+        want = np.zeros((6, 4), np.float32)
+        for j, k in enumerate(keys):
+            want[:, k] += x[:, j]
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_mse_divide(self, mats):
+        a, b = mats
+        np.testing.assert_allclose(linalg.mean_squared_error(a, b),
+                                   ((a - b) ** 2).mean(), rtol=1e-5)
+        num = np.array([1.0, 2.0], np.float32)
+        den = np.array([2.0, 0.0], np.float32)
+        np.testing.assert_allclose(
+            linalg.binary_div_skip_zero(num, den, return_zero=True), [0.5, 0.0])
+
+
+class TestGemm:
+    def test_gemm_variants(self, rng_np):
+        a = rng_np.standard_normal((5, 7)).astype(np.float32)
+        b = rng_np.standard_normal((7, 3)).astype(np.float32)
+        c = rng_np.standard_normal((5, 3)).astype(np.float32)
+        np.testing.assert_allclose(linalg.gemm(a, b), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(linalg.gemm(a.T, b, trans_a=True), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(linalg.gemm(a, b.T, trans_b=True), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            linalg.gemm(a, b, alpha=2.0, beta=0.5, c=c), 2 * a @ b + 0.5 * c, rtol=1e-5)
+
+    def test_gemv(self, rng_np):
+        a = rng_np.standard_normal((5, 7)).astype(np.float32)
+        x = rng_np.standard_normal(7).astype(np.float32)
+        np.testing.assert_allclose(linalg.gemv(a, x), a @ x, rtol=1e-5)
+
+
+class TestMatrixVector:
+    def test_along_rows_cols(self, mats):
+        a, _ = mats
+        v_row = np.arange(a.shape[1], dtype=np.float32)
+        v_col = np.arange(a.shape[0], dtype=np.float32)
+        np.testing.assert_allclose(
+            mv.matrix_vector_add(a, v_row, along_rows=True), a + v_row[None, :], rtol=1e-6)
+        np.testing.assert_allclose(
+            mv.matrix_vector_mul(a, v_col, along_rows=False), a * v_col[:, None], rtol=1e-6)
+
+
+class TestDecomp:
+    def test_eig(self, rng_np):
+        a = rng_np.standard_normal((12, 12)).astype(np.float32)
+        sym = (a + a.T) / 2
+        v, w = linalg.eig_dc(sym)
+        np.testing.assert_allclose(np.asarray(v) @ np.diag(np.asarray(w)) @ np.asarray(v).T,
+                                   sym, atol=1e-3)
+
+    def test_eig_sel(self, rng_np):
+        a = rng_np.standard_normal((10, 10)).astype(np.float32)
+        sym = (a + a.T) / 2
+        v, w = linalg.eig_sel_dc(sym, 3, largest=True)
+        w_np = np.linalg.eigvalsh(sym)
+        np.testing.assert_allclose(np.asarray(w), w_np[-3:], atol=1e-3)
+
+    def test_svd_qr(self, rng_np):
+        a = rng_np.standard_normal((15, 6)).astype(np.float32)
+        u, s, v = linalg.svd_qr(a)
+        rec = np.asarray(linalg.svd_reconstruction(u, s, v))
+        np.testing.assert_allclose(rec, a, atol=1e-3)
+
+    def test_svd_eig_tall(self, rng_np):
+        a = rng_np.standard_normal((40, 5)).astype(np.float32)
+        u, s, v = linalg.svd_eig(a)
+        s_np = np.linalg.svd(a, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(s), s_np, rtol=1e-2, atol=1e-2)
+        rec = np.asarray(linalg.svd_reconstruction(u, s, v))
+        np.testing.assert_allclose(rec, a, atol=1e-2)
+
+    def test_rsvd(self, rng_np):
+        # low-rank matrix: rsvd should recover the spectrum
+        u0 = rng_np.standard_normal((60, 5)).astype(np.float32)
+        v0 = rng_np.standard_normal((5, 30)).astype(np.float32)
+        a = u0 @ v0
+        u, s, v = linalg.rsvd_fixed_rank(a, k=5, p=8, n_iters=3)
+        s_np = np.linalg.svd(a, compute_uv=False)[:5]
+        np.testing.assert_allclose(np.asarray(s), s_np, rtol=1e-2)
+
+    def test_lstsq_variants(self, rng_np):
+        a = rng_np.standard_normal((40, 6)).astype(np.float32)
+        w_true = rng_np.standard_normal(6).astype(np.float32)
+        b = a @ w_true
+        for fn in (linalg.lstsq_svd_qr, linalg.lstsq_eig, linalg.lstsq_qr,
+                   linalg.lstsq_svd_jacobi):
+            w = np.asarray(fn(a, b))
+            np.testing.assert_allclose(w, w_true, atol=2e-2), fn.__name__
+
+    def test_cholesky_rank1(self, rng_np):
+        a = rng_np.standard_normal((6, 6)).astype(np.float32)
+        spd = a @ a.T + 6 * np.eye(6, dtype=np.float32)
+        l_np = np.linalg.cholesky(spd)
+        # grow the factor one row at a time
+        l = jnp.zeros((6, 6), jnp.float32)
+        for n in range(1, 7):
+            l = l.at[n - 1, :n].set(spd[n - 1, :n])
+            l = linalg.cholesky_rank1_update(l, n)
+        np.testing.assert_allclose(np.asarray(l), l_np, atol=1e-3)
+
+
+class TestLanczos:
+    def test_smallest_largest(self, rng_np):
+        n = 60
+        a = rng_np.standard_normal((n, n)).astype(np.float32)
+        sym = ((a + a.T) / 2).astype(np.float32)
+        w_np = np.linalg.eigvalsh(sym)
+        matvec = lambda v: jnp.asarray(sym) @ v
+        w_small, v_small = linalg.lanczos_smallest_eigenvectors(matvec, n, 3, ncv=40)
+        np.testing.assert_allclose(np.asarray(w_small), w_np[:3], atol=1e-2)
+        w_large, _ = linalg.lanczos_largest_eigenvectors(matvec, n, 3, ncv=40)
+        np.testing.assert_allclose(np.asarray(w_large), w_np[-3:][::-1], atol=1e-2)
+        # residual check ||A v - w v||
+        for i in range(3):
+            v = np.asarray(v_small[:, i])
+            r = sym @ v - np.asarray(w_small)[i] * v
+            # f32 + ncv=40 Krylov: residual ~3e-3 relative to ||A||~10
+            assert np.linalg.norm(r) < 5e-2

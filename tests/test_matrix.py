@@ -1,0 +1,64 @@
+"""matrix utils tests (analog of reference cpp/test/matrix/*)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import matrix
+
+
+@pytest.fixture
+def m(rng_np):
+    return rng_np.standard_normal((8, 6)).astype(np.float32)
+
+
+def test_copy_rows(m):
+    idx = np.array([3, 0, 5])
+    np.testing.assert_array_equal(matrix.copy_rows(m, idx), m[idx])
+
+
+def test_slice(m):
+    np.testing.assert_array_equal(matrix.slice_matrix(m, 1, 2, 5, 4), m[1:5, 2:4])
+
+
+def test_reverse(m):
+    np.testing.assert_array_equal(matrix.col_reverse(m), m[:, ::-1])
+    np.testing.assert_array_equal(matrix.row_reverse(m), m[::-1, :])
+
+
+def test_diagonal(m):
+    sq = m[:6, :6]
+    np.testing.assert_array_equal(matrix.get_diagonal(sq), np.diagonal(sq))
+    newdiag = np.arange(6, dtype=np.float32)
+    got = np.asarray(matrix.set_diagonal(sq, newdiag))
+    np.testing.assert_array_equal(np.diagonal(got), newdiag)
+    inv = np.asarray(matrix.invert_diagonal(sq))
+    np.testing.assert_allclose(np.diagonal(inv), 1.0 / np.diagonal(sq), rtol=1e-5)
+
+
+def test_argmax_argmin(m):
+    np.testing.assert_array_equal(matrix.argmax(m, axis=1), m.argmax(1))
+    np.testing.assert_array_equal(matrix.argmin(m, axis=0), m.argmin(0))
+
+
+def test_ratio(m):
+    x = np.abs(m) + 0.1
+    np.testing.assert_allclose(matrix.ratio(x), x / x.sum(), rtol=1e-5)
+
+
+def test_seq_root():
+    x = np.array([4.0, -1.0, 9.0], np.float32)
+    np.testing.assert_allclose(matrix.seq_root(x, set_neg_zero=True), [2.0, 0.0, 3.0])
+
+
+def test_zero_small_values():
+    x = np.array([1e-20, 0.5, -1e-18], np.float32)
+    got = np.asarray(matrix.zero_small_values(x))
+    np.testing.assert_array_equal(got, [0.0, 0.5, 0.0])
+
+
+def test_sort_cols_per_row(m):
+    vals, idx = matrix.sort_cols_per_row(m, ascending=True)
+    np.testing.assert_allclose(np.asarray(vals), np.sort(m, axis=1), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), np.argsort(m, axis=1, kind="stable"))
+    vals_d, _ = matrix.sort_cols_per_row(m, ascending=False)
+    np.testing.assert_allclose(np.asarray(vals_d), -np.sort(-m, axis=1), rtol=1e-6)

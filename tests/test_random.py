@@ -1,0 +1,166 @@
+"""random subsystem tests — moment checks like reference cpp/test/random/rng.cu
+and cluster-recovery like cpp/test/random/make_blobs.cu."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import random as rrandom
+from raft_tpu.random import RngState
+
+
+N = 20000
+TOL = 0.05
+
+
+class TestDistributions:
+    def test_uniform_moments(self):
+        x = np.asarray(rrandom.uniform(RngState(1), (N,), low=-2.0, high=4.0))
+        assert abs(x.mean() - 1.0) < TOL * 6
+        assert x.min() >= -2 and x.max() < 4
+
+    def test_normal_moments(self):
+        x = np.asarray(rrandom.normal(RngState(2), (N,), mu=1.5, sigma=2.0))
+        assert abs(x.mean() - 1.5) < 0.1
+        assert abs(x.std() - 2.0) < 0.1
+
+    def test_lognormal(self):
+        x = np.asarray(rrandom.lognormal(RngState(3), (N,), mu=0.0, sigma=0.5))
+        assert (x > 0).all()
+        want_mean = np.exp(0.125)
+        assert abs(x.mean() - want_mean) < 0.1
+
+    def test_exponential(self):
+        lam = 2.0
+        x = np.asarray(rrandom.exponential(RngState(4), (N,), lam=lam))
+        assert abs(x.mean() - 1 / lam) < 0.05
+
+    def test_rayleigh(self):
+        sigma = 1.5
+        x = np.asarray(rrandom.rayleigh(RngState(5), (N,), sigma=sigma))
+        want = sigma * np.sqrt(np.pi / 2)
+        assert abs(x.mean() - want) < 0.1
+
+    def test_laplace_gumbel_logistic(self):
+        for fn, mean_tol in [(rrandom.laplace, 0.1), (rrandom.logistic, 0.1)]:
+            x = np.asarray(fn(RngState(6), (N,), 0.5, 1.0))
+            assert abs(x.mean() - 0.5) < mean_tol
+        g = np.asarray(rrandom.gumbel(RngState(7), (N,), mu=0.0, beta=1.0))
+        assert abs(g.mean() - 0.5772) < 0.1  # Euler-Mascheroni
+
+    def test_bernoulli(self):
+        x = np.asarray(rrandom.bernoulli(RngState(8), (N,), 0.3, dtype=jnp.float32))
+        assert abs(x.mean() - 0.3) < 0.02
+
+    def test_scaled_bernoulli(self):
+        x = np.asarray(rrandom.scaled_bernoulli(RngState(9), (N,), 0.5, 2.0))
+        assert set(np.unique(x)) == {-2.0, 2.0}
+
+    def test_uniform_int(self):
+        x = np.asarray(rrandom.uniform_int(RngState(10), (N,), 3, 9))
+        assert x.min() >= 3 and x.max() < 9
+
+    def test_normal_table(self):
+        mu = np.array([0.0, 10.0, -5.0], np.float32)
+        sigma = np.array([1.0, 2.0, 0.5], np.float32)
+        x = np.asarray(rrandom.normal_table(RngState(11), N, mu, sigma))
+        np.testing.assert_allclose(x.mean(0), mu, atol=0.15)
+        np.testing.assert_allclose(x.std(0), sigma, atol=0.15)
+
+    def test_fill(self):
+        x = np.asarray(rrandom.fill(RngState(12), (5,), 7.0))
+        np.testing.assert_array_equal(x, np.full(5, 7.0, np.float32))
+
+    def test_discrete(self):
+        probs = np.array([0.1, 0.6, 0.3])
+        x = np.asarray(rrandom.discrete(RngState(13), (N,), probs))
+        counts = np.bincount(x, minlength=3) / N
+        np.testing.assert_allclose(counts, probs, atol=0.03)
+
+    def test_custom_distribution(self):
+        # inverse CDF of exponential(1)
+        x = np.asarray(rrandom.custom_distribution(
+            RngState(14), (N,), lambda u: -jnp.log1p(-u * (1 - 1e-7))))
+        assert abs(x.mean() - 1.0) < 0.05
+
+    def test_state_advance_determinism(self):
+        s1 = RngState(42)
+        a = np.asarray(rrandom.uniform(s1, (10,)))
+        b = np.asarray(rrandom.uniform(s1, (10,)))
+        assert not np.allclose(a, b)  # state advanced
+        s2 = RngState(42)
+        a2 = np.asarray(rrandom.uniform(s2, (10,)))
+        np.testing.assert_array_equal(a, a2)  # reproducible
+
+
+class TestSampling:
+    def test_sample_without_replacement_unique(self):
+        idx, _ = rrandom.sample_without_replacement(RngState(1), 50, 100)
+        idx = np.asarray(idx)
+        assert len(np.unique(idx)) == 50
+        assert idx.min() >= 0 and idx.max() < 100
+
+    def test_sample_weighted_bias(self):
+        # heavily weighted item should virtually always be selected
+        w = np.ones(100, np.float32)
+        w[7] = 10000.0
+        hits = 0
+        for seed in range(20):
+            idx, _ = rrandom.sample_without_replacement(RngState(seed), 10, 100, weights=w)
+            hits += int(7 in np.asarray(idx))
+        assert hits >= 19
+
+    def test_permute(self, rng_np):
+        x = rng_np.standard_normal((30, 4)).astype(np.float32)
+        perm, out = rrandom.permute(RngState(3), 30, x)
+        perm = np.asarray(perm)
+        assert len(np.unique(perm)) == 30
+        np.testing.assert_array_equal(np.asarray(out), x[perm])
+
+
+class TestGenerators:
+    def test_make_blobs_recovery(self):
+        data, labels = rrandom.make_blobs(2000, 8, n_clusters=4,
+                                          state=RngState(0), cluster_std=0.3)
+        data, labels = np.asarray(data), np.asarray(labels)
+        assert data.shape == (2000, 8) and labels.shape == (2000,)
+        assert set(np.unique(labels)) == {0, 1, 2, 3}
+        # within-cluster scatter should be tiny vs between-cluster distances
+        centers = np.stack([data[labels == c].mean(0) for c in range(4)])
+        for c in range(4):
+            spread = np.linalg.norm(data[labels == c] - centers[c], axis=1).mean()
+            assert spread < 0.3 * np.sqrt(8) * 2
+        d01 = np.linalg.norm(centers[0] - centers[1])
+        assert d01 > 1.0
+
+    def test_make_blobs_given_centers(self):
+        centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+        data, labels = rrandom.make_blobs(200, 2, state=RngState(1),
+                                          centers=centers, cluster_std=0.1,
+                                          shuffle=False)
+        data, labels = np.asarray(data), np.asarray(labels)
+        np.testing.assert_allclose(data[labels == 1].mean(0), [100, 100], atol=0.2)
+
+    def test_make_regression_exact(self):
+        x, y, w = rrandom.make_regression(300, 10, n_informative=5,
+                                          state=RngState(2), noise=0.0,
+                                          shuffle=True, coef=True)
+        x, y, w = np.asarray(x), np.asarray(y), np.asarray(w)
+        np.testing.assert_allclose(y, x @ w, rtol=1e-3, atol=1e-2)
+        assert (np.abs(w) > 1e-6).sum() == 5
+
+    def test_make_regression_lowrank(self):
+        x, y = rrandom.make_regression(100, 40, n_informative=10,
+                                       state=RngState(3), effective_rank=5,
+                                       tail_strength=0.1)
+        s = np.linalg.svd(np.asarray(x), compute_uv=False)
+        # effective rank ~5 -> fast spectral decay
+        assert s[10] < 0.2 * s[0]
+
+    def test_multi_variable_gaussian(self):
+        cov = np.array([[2.0, 0.8], [0.8, 1.0]], np.float32)
+        mu = np.array([1.0, -1.0], np.float32)
+        x = np.asarray(rrandom.multi_variable_gaussian(RngState(4), 30000, mu, cov))
+        assert x.shape == (2, 30000)
+        np.testing.assert_allclose(x.mean(1), mu, atol=0.05)
+        np.testing.assert_allclose(np.cov(x), cov, atol=0.1)
