@@ -1,0 +1,72 @@
+"""Headline benchmark — pairwise L2 distance throughput on TPU.
+
+Mirrors the reference's distance benchmark (cpp/bench/distance/distance_exp_l2.cu
+via the shared harness cpp/bench/distance/distance_common.cuh): time the
+expanded-L2 pairwise distance engine on a large square problem.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is value / 10_000 GFLOPS — a RAFT-on-A100 estimate for the f32
+pairwise-distance suite (the reference publishes no absolute numbers;
+BASELINE.md records `"published": {}`), i.e. vs_baseline >= 1.0 means we beat
+the A100 reference estimate.
+
+Timing methodology: the repeat loop lives INSIDE one jit (lax.fori_loop) —
+per-dispatch latency through the axon tunnel is ~10 ms, so host-side loops
+measure the tunnel, not the chip.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.distance.pairwise import _expanded_impl
+from raft_tpu.distance.distance_type import DistanceType
+
+
+def main():
+    m = n = 8192
+    d = 512
+    iters = 20
+
+    rng = np.random.default_rng(42)
+    # TPU-idiomatic: bf16 operands, f32 MXU accumulation (preferred_element_type)
+    x = jax.device_put(rng.standard_normal((m, d)).astype(jnp.bfloat16))
+    y = jax.device_put(rng.standard_normal((n, d)).astype(jnp.bfloat16))
+
+    @jax.jit
+    def loop(x, y):
+        def body(i, acc):
+            dmat = _expanded_impl(
+                DistanceType.L2Expanded, x + i * 0.0, y, "default"
+            )
+            # full-matrix reduce pins the dependence on every output element;
+            # a sliced read would let XLA narrow the dot to two rows and
+            # overstate GFLOPS by orders of magnitude.
+            return acc + jnp.sum(dmat)
+        return lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+    loop(x, y).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    float(loop(x, y))
+    dt = (time.perf_counter() - t0) / iters
+
+    gflops = 2.0 * m * n * d / dt / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "pairwise_l2_expanded_8192x8192x512_bf16",
+                "value": round(gflops, 1),
+                "unit": "GFLOPS",
+                "vs_baseline": round(gflops / 10_000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

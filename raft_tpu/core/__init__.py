@@ -9,7 +9,7 @@ NVTX ranges -> :mod:`annotate` (jax.profiler traces); ``interruptible`` ->
 from raft_tpu.core.resources import Resources, DeviceResources, get_default_resources
 from raft_tpu.core import logger
 from raft_tpu.core.annotate import annotate, push_range, pop_range
-from raft_tpu.core.interruptible import Interruptible, InterruptedError as RaftInterruptedError
+from raft_tpu.core.interruptible import Interruptible, InterruptedException as RaftInterruptedError
 
 __all__ = [
     "Resources",

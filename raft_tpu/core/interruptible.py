@@ -17,14 +17,18 @@ cancellation is cooperative, not preemptive).
 from __future__ import annotations
 
 import threading
-import weakref
 from typing import Dict, Optional
 
 import jax
 
 
-class InterruptedError(RuntimeError):
-    """Raised inside the cancelled thread (reference: raft::interrupted_exception)."""
+class InterruptedException(RuntimeError):
+    """Raised inside the cancelled thread (reference: raft::interrupted_exception).
+
+    Deliberately NOT named ``InterruptedError`` — that would shadow the
+    Python builtin (an OSError subclass) and change exception-handling
+    semantics for importers.
+    """
 
 
 class Interruptible:
@@ -55,6 +59,13 @@ class Interruptible:
         (reference interruptible.hpp:84 get_token())."""
         tid = threading.get_ident() if thread_id is None else thread_id
         with cls._registry_lock:
+            # prune tokens of dead threads so the registry stays bounded and
+            # a reused OS thread id cannot inherit a stale cancelled token
+            # (the reference uses a weak-pointer registry for the same reason,
+            # interruptible.hpp:140-168).
+            live = {t.ident for t in threading.enumerate()}
+            for dead in [k for k in cls._registry if k not in live and k != tid]:
+                del cls._registry[dead]
             tok = cls._registry.get(tid)
             if tok is None:
                 tok = cls()
@@ -68,7 +79,7 @@ class Interruptible:
         tok = cls.get_token()
         if tok.cancelled():
             tok.clear()
-            raise InterruptedError("raft_tpu: thread interrupted")
+            raise InterruptedException("raft_tpu: thread interrupted")
 
     @classmethod
     def yield_no_throw(cls) -> bool:

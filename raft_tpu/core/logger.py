@@ -33,6 +33,25 @@ _TO_PY = {
     TRACE: 5,
 }
 
+_FROM_PY = {py: raft for raft, py in _TO_PY.items()}
+
+
+def _to_raft_level(levelno: int) -> int:
+    """Map a Python levelno back to reference numbering for callbacks."""
+    if levelno in _FROM_PY:
+        return _FROM_PY[levelno]
+    if levelno >= logging.CRITICAL:
+        return CRITICAL
+    if levelno >= logging.ERROR:
+        return ERROR
+    if levelno >= logging.WARNING:
+        return WARN
+    if levelno >= logging.INFO:
+        return INFO
+    if levelno >= logging.DEBUG:
+        return DEBUG
+    return TRACE
+
 logging.addLevelName(5, "TRACE")
 
 _logger = logging.getLogger("raft_tpu")
@@ -49,7 +68,9 @@ class _CallbackHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         msg = self.format(record)
         if _callback is not None:
-            _callback(record.levelno, msg)
+            # callbacks receive reference-numbered levels (logger.hpp:36-42:
+            # higher = more verbose), not Python levelnos
+            _callback(_to_raft_level(record.levelno), msg)
         else:
             sys.stderr.write(msg + "\n")
 

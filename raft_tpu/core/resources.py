@@ -93,13 +93,18 @@ class Resources:
     def is_tpu(self) -> bool:
         return getattr(self.device, "platform", "cpu") == "tpu"
 
-    def sync(self) -> None:
-        """Block until all outstanding async work on this device is done.
+    def sync(self, *arrays) -> None:
+        """Block until the given arrays (or, with no args, all dispatched
+        side-effecting computations) are done.
 
-        Analog of ``handle.sync_stream()``; jax arrays are async by default.
+        Analog of ``handle.sync_stream()``. JAX gives no global barrier over
+        *pure* in-flight computations that you hold no reference to — pass
+        the outputs you need ordered: ``res.sync(out)``.
         """
-        # effects barrier: a tiny transfer forces completion of prior work
-        jax.block_until_ready(jax.device_put(np.zeros((), np.int32), self.device))
+        if arrays:
+            jax.block_until_ready(arrays)
+        else:
+            jax.effects_barrier()
 
 
 # Backwards-compatible alias mirroring raft 22.08's rename handle_t -> device_resources

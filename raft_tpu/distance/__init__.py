@@ -1,0 +1,37 @@
+"""Pairwise distance layer — analog of raft/distance (reference
+cpp/include/raft/distance/, ~6.4 kLoC CUDA; see SURVEY.md §2 #12-15).
+
+MXU-ridden expanded metrics + Pallas-tiled VPU unexpanded metrics + fused
+L2 1-NN. Public surface mirrors ``raft::distance``.
+"""
+
+from raft_tpu.distance.distance_type import (
+    DistanceType,
+    DISTANCE_NAMES,
+    EXPANDED_METRICS,
+    UNEXPANDED_METRICS,
+    resolve_metric,
+)
+from raft_tpu.distance.pairwise import (
+    pairwise_distance,
+    distance,
+    haversine_distance,
+    row_norm_sq,
+)
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn, fused_l2_nn_argmin
+from raft_tpu.distance.pallas_kernels import pallas_pairwise
+
+__all__ = [
+    "DistanceType",
+    "DISTANCE_NAMES",
+    "EXPANDED_METRICS",
+    "UNEXPANDED_METRICS",
+    "resolve_metric",
+    "pairwise_distance",
+    "distance",
+    "haversine_distance",
+    "row_norm_sq",
+    "fused_l2_nn",
+    "fused_l2_nn_argmin",
+    "pallas_pairwise",
+]

@@ -1,0 +1,343 @@
+"""Pairwise distance engine — TPU-native analog of the reference distance
+layer (cpp/include/raft/distance/distance.cuh:293-450 dispatch;
+detail/pairwise_distance_base.cuh `PairwiseDistances` kernel skeleton;
+per-metric impls detail/{euclidean,cosine,l1,...}.cuh).
+
+Design (TPU-first, not a translation):
+
+* **Expanded metrics** (L2/cosine/correlation/inner-product/hellinger/
+  russellrao/jaccard/dice) ride the **MXU**: one ``lax.dot_general`` gram
+  matrix in f32-accumulate plus an elementwise epilogue with the row norms —
+  the same norm-trick the reference uses (detail/euclidean.cuh
+  ``euclideanAlgo1``), but expressed so XLA fuses the epilogue into the
+  matmul's output.
+* **Unexpanded metrics** (L1/Linf/Canberra/Lp/Hamming/JS/KL/BrayCurtis/
+  L2Unexpanded) are **VPU** work: an accumulate-over-features loop. Two
+  paths: an XLA broadcast-reduce (compiler-fused; good on CPU and for small
+  shapes) and a tiled Pallas kernel (``pallas_pairwise`` in
+  :mod:`raft_tpu.distance.pallas_kernels`) that mirrors the reference's
+  2D-tile engine (detail/pairwise_distance_base.cuh:122-226) with VMEM tiles
+  instead of shared memory.
+* ``fin_op`` is fused into the epilogue exactly like the reference's fused
+  final op (pairwise_distance_base.cuh epilog), so e.g. epsilon-neighborhood
+  thresholding never materialises the raw distance matrix.
+
+All functions are jit-friendly: static metric, static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.distance_type import (
+    DistanceType,
+    EXPANDED_METRICS,
+    resolve_metric,
+)
+
+__all__ = ["pairwise_distance", "distance", "row_norm_sq", "haversine_distance"]
+
+
+def row_norm_sq(x):
+    """Squared L2 row norms, f32 accumulate (reference linalg norm in the
+    expanded-distance prologue, detail/euclidean.cuh)."""
+    x = jnp.asarray(x)
+    return jnp.sum(
+        x.astype(jnp.promote_types(x.dtype, jnp.float32)) ** 2, axis=-1
+    ).astype(x.dtype)
+
+
+def _gram(x, y, precision=None):
+    """x @ y.T with f32 accumulation on the MXU.
+
+    Default precision is HIGHEST so f32 inputs match the reference's f32
+    CUDA arithmetic; pass ``precision="default"`` for the fast bf16-input
+    MXU path (the bench does, with bf16 data).
+    """
+    if precision is None:
+        precision = lax.Precision.HIGHEST
+    out_t = jnp.promote_types(x.dtype, jnp.float32)
+    return lax.dot_general(
+        x,
+        y,
+        (((1,), (1,)), ((), ())),
+        precision=precision,
+        preferred_element_type=out_t,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Expanded (MXU) metrics: gram + epilogue
+# ---------------------------------------------------------------------------
+
+
+def _expanded_impl(metric: DistanceType, x, y, precision):
+    # Norms/epilogue always accumulate in f32; the gram keeps the INPUT dtype
+    # so bf16 operands take the fast MXU path (f32 accumulation comes from
+    # preferred_element_type in _gram) instead of being upcast and doubling
+    # operand HBM traffic.
+    f32 = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(f32)
+    yf = y.astype(f32)
+
+    if metric == DistanceType.InnerProduct:
+        return _gram(x, y, precision)
+
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        g = _gram(x, y, precision)
+        xn = jnp.sum(xf * xf, axis=-1)
+        yn = jnp.sum(yf * yf, axis=-1)
+        d2 = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * g, 0.0)
+        if metric == DistanceType.L2SqrtExpanded:
+            return jnp.sqrt(d2)
+        return d2
+
+    if metric == DistanceType.CosineExpanded:
+        g = _gram(x, y, precision)
+        xn = jnp.sqrt(jnp.sum(xf * xf, axis=-1))
+        yn = jnp.sqrt(jnp.sum(yf * yf, axis=-1))
+        denom = xn[:, None] * yn[None, :]
+        return 1.0 - g / jnp.where(denom == 0, 1.0, denom)
+
+    if metric == DistanceType.CorrelationExpanded:
+        # center rows, then cosine (reference detail/correlation.cuh computes
+        # the same quantity from raw moments).
+        xc = xf - jnp.mean(xf, axis=-1, keepdims=True)
+        yc = yf - jnp.mean(yf, axis=-1, keepdims=True)
+        g = _gram(xc, yc, precision)
+        xn = jnp.sqrt(jnp.sum(xc * xc, axis=-1))
+        yn = jnp.sqrt(jnp.sum(yc * yc, axis=-1))
+        denom = xn[:, None] * yn[None, :]
+        return 1.0 - g / jnp.where(denom == 0, 1.0, denom)
+
+    if metric == DistanceType.HellingerExpanded:
+        # 1 - sum_k sqrt(x_k y_k); inputs assumed nonneg (probability rows)
+        # (reference detail/hellinger.cuh). sqrt first, then one MXU gram.
+        g = _gram(jnp.sqrt(jnp.maximum(x, 0)), jnp.sqrt(jnp.maximum(y, 0)), precision)
+        return jnp.sqrt(jnp.maximum(1.0 - g, 0.0))
+
+    if metric == DistanceType.RusselRaoExpanded:
+        # (d - <x,y>) / d on boolean-like data (reference detail/russell_rao.cuh)
+        d = x.shape[-1]
+        g = _gram(x, y, precision)
+        return (d - g) / d
+
+    if metric == DistanceType.JaccardExpanded:
+        # boolean jaccard via grams: 1 - |x∧y| / (|x| + |y| - |x∧y|)
+        # (the reference enum lists it without a dense impl; provided here
+        # as a native extension.)
+        g = _gram(x, y, precision)
+        xs = jnp.sum(xf, axis=-1)
+        ys = jnp.sum(yf, axis=-1)
+        denom = xs[:, None] + ys[None, :] - g
+        return 1.0 - g / jnp.where(denom == 0, 1.0, denom)
+
+    if metric == DistanceType.DiceExpanded:
+        g = _gram(x, y, precision)
+        xs = jnp.sum(xf, axis=-1)
+        ys = jnp.sum(yf, axis=-1)
+        denom = xs[:, None] + ys[None, :]
+        return 1.0 - 2.0 * g / jnp.where(denom == 0, 1.0, denom)
+
+    raise NotImplementedError(metric)
+
+
+# ---------------------------------------------------------------------------
+# Unexpanded (VPU) metrics: accumulate core(x_k, y_k) over features
+# ---------------------------------------------------------------------------
+
+# Each entry: (n_accumulators, core(xc, yc) -> tuple of per-feature terms,
+#              finalize(accs..., d, p) -> dist). xc has shape (..., m, 1, bk),
+# yc has shape (..., 1, n, bk); terms reduce-sum over the last axis except for
+# Linf which reduce-maxes (handled via reducer field).
+
+
+def _safe_div(num, den):
+    return num / jnp.where(den == 0, 1.0, den)
+
+
+def _core_l1(xc, yc):
+    return (jnp.abs(xc - yc),)
+
+
+def _core_l2(xc, yc):
+    d = xc - yc
+    return (d * d,)
+
+
+def _core_linf(xc, yc):
+    return (jnp.abs(xc - yc),)
+
+
+def _core_canberra(xc, yc):
+    num = jnp.abs(xc - yc)
+    den = jnp.abs(xc) + jnp.abs(yc)
+    return (_safe_div(num, den) * (den != 0),)
+
+
+def _core_hamming(xc, yc):
+    return ((xc != yc).astype(jnp.float32),)
+
+
+def _core_kl(xc, yc):
+    # sum x log(x/y); zero where x == 0 (reference detail/kl_divergence.cuh)
+    ratio = _safe_div(xc, yc)
+    return (jnp.where(xc > 0, xc * jnp.log(jnp.where(ratio > 0, ratio, 1.0)), 0.0),)
+
+
+def _core_js(xc, yc):
+    m = 0.5 * (xc + yc)
+    t1 = jnp.where(xc > 0, xc * jnp.log(_safe_div(xc, m)), 0.0)
+    t2 = jnp.where(yc > 0, yc * jnp.log(_safe_div(yc, m)), 0.0)
+    return (0.5 * (t1 + t2),)
+
+
+def _core_braycurtis(xc, yc):
+    return (jnp.abs(xc - yc), jnp.abs(xc + yc))
+
+
+_UNEXPANDED_TABLE = {
+    DistanceType.L1: dict(core=_core_l1, reducer="sum", fin=lambda a, d, p: a[0]),
+    DistanceType.L2Unexpanded: dict(core=_core_l2, reducer="sum", fin=lambda a, d, p: a[0]),
+    DistanceType.L2SqrtUnexpanded: dict(
+        core=_core_l2, reducer="sum", fin=lambda a, d, p: jnp.sqrt(a[0])
+    ),
+    DistanceType.Linf: dict(core=_core_linf, reducer="max", fin=lambda a, d, p: a[0]),
+    DistanceType.Canberra: dict(core=_core_canberra, reducer="sum", fin=lambda a, d, p: a[0]),
+    DistanceType.HammingUnexpanded: dict(
+        core=_core_hamming, reducer="sum", fin=lambda a, d, p: a[0] / d
+    ),
+    DistanceType.KLDivergence: dict(core=_core_kl, reducer="sum", fin=lambda a, d, p: a[0]),
+    DistanceType.JensenShannon: dict(
+        core=_core_js, reducer="sum", fin=lambda a, d, p: jnp.sqrt(jnp.maximum(a[0], 0.0))
+    ),
+    DistanceType.BrayCurtis: dict(
+        core=_core_braycurtis, reducer="sum", fin=lambda a, d, p: _safe_div(a[0], a[1])
+    ),
+}
+
+
+def _lp_table(p):
+    return dict(
+        core=lambda xc, yc: (jnp.abs(xc - yc) ** p,),
+        reducer="sum",
+        fin=lambda a, d, _p: a[0] ** (1.0 / p),
+    )
+
+
+def _unexpanded_block(x, y, spec):
+    """One (m_block, n, d) broadcast-reduce; XLA fuses this into a single
+    VPU loop (no (m,n,d) materialisation — it is a fusion root into the
+    reduction)."""
+    reducer = jnp.sum if spec["reducer"] == "sum" else jnp.max
+    terms = spec["core"](x[:, None, :], y[None, :, :])
+    accs = tuple(reducer(t, axis=-1) for t in terms)
+    return spec["fin"](accs, x.shape[-1], None)
+
+
+def _unexpanded_impl(metric, x, y, p, block_m):
+    f32 = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(f32)
+    yf = y.astype(f32)
+    spec = _lp_table(p) if metric == DistanceType.LpUnexpanded else _UNEXPANDED_TABLE[metric]
+
+    m = xf.shape[0]
+    if block_m is None or block_m >= m:
+        return _unexpanded_block(xf, yf, spec)
+
+    # grid-stride analog: pad m to a block multiple, lax.map over row blocks
+    # (reference pairwise_distance_base.cuh:122-134 grid-stride tiles).
+    n_blocks = -(-m // block_m)
+    pad = n_blocks * block_m - m
+    xp = jnp.pad(xf, ((0, pad), (0, 0)))
+    xb = xp.reshape(n_blocks, block_m, xf.shape[1])
+    out = lax.map(lambda blk: _unexpanded_block(blk, yf, spec), xb)
+    return out.reshape(n_blocks * block_m, yf.shape[0])[:m]
+
+
+# ---------------------------------------------------------------------------
+# Haversine (2-d lat/lon rows, reference detail/haversine_distance.cuh:35-57)
+# ---------------------------------------------------------------------------
+
+
+def haversine_distance(x, y):
+    """Pairwise haversine on (lat, lon) radian rows; returns the great-circle
+    distance on the unit sphere (reference haversine_distance.cuh:40-50)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    lat1, lon1 = x[:, 0][:, None], x[:, 1][:, None]
+    lat2, lon2 = y[:, 0][None, :], y[:, 1][None, :]
+    sin_lat = jnp.sin(0.5 * (lat1 - lat2))
+    sin_lon = jnp.sin(0.5 * (lon1 - lon2))
+    a = sin_lat**2 + jnp.cos(lat1) * jnp.cos(lat2) * sin_lon**2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+# ---------------------------------------------------------------------------
+# Public dispatch (reference distance.cuh:293-369 runtime-metric switch)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("metric", "p", "fin_op", "block_m", "method", "precision"),
+)
+def pairwise_distance(
+    x,
+    y,
+    metric="euclidean",
+    *,
+    p: float = 2.0,
+    fin_op: Optional[Callable] = None,
+    block_m: Optional[int] = None,
+    method: str = "auto",
+    precision=None,
+):
+    """Compute the full m×n distance matrix.
+
+    Parameters mirror ``raft::distance::pairwise_distance``
+    (reference distance.cuh:417-450) with ``fin_op`` fused like the kernel's
+    final op (pairwise_distance_base.cuh epilog).
+
+    method: "auto" | "xla" | "pallas" — pallas selects the tiled VPU kernel
+    for unexpanded metrics on TPU backends.
+
+    Note: ``fin_op`` is a static (trace-time) argument — pass a *stable*
+    callable (module-level function or cached lambda); a fresh lambda per
+    call defeats the jit cache and recompiles every time.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.ndim != 2 or y.ndim != 2 or x.shape[1] != y.shape[1]:
+        raise ValueError(f"shape mismatch: {x.shape} vs {y.shape}")
+    metric = resolve_metric(metric)
+
+    if metric == DistanceType.Haversine:
+        out = haversine_distance(x, y)
+    elif metric in EXPANDED_METRICS:
+        out = _expanded_impl(metric, x, y, precision)
+    else:
+        # measured on v5e: XLA's broadcast-reduce fusion currently beats the
+        # pallas tile kernel for VPU metrics (it never materialises (m,n,d) —
+        # the broadcast is a fusion root into the reduction), so "auto" stays
+        # on the XLA path; pallas remains opt-in while it is tuned.
+        if method == "pallas":
+            from raft_tpu.distance.pallas_kernels import pallas_pairwise
+
+            out = pallas_pairwise(x, y, metric, p=p)
+        else:
+            out = _unexpanded_impl(metric, x, y, p, block_m)
+
+    if fin_op is not None:
+        out = fin_op(out)
+    return out
+
+
+def distance(x, y, metric="euclidean", **kw):
+    """Alias matching ``raft::distance::distance`` (reference distance.cuh:200)."""
+    return pairwise_distance(x, y, metric, **kw)

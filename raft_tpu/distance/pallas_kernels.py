@@ -1,0 +1,176 @@
+"""Tiled Pallas pairwise-distance kernel — TPU-native analog of the
+reference's 2D-tile distance engine (cpp/include/raft/distance/detail/
+pairwise_distance_base.cuh:76-379 ``PairwiseDistances`` +
+linalg/detail/contractions.cuh ``Contractions_NT``).
+
+Where the reference double-buffers x/y tiles through CUDA shared memory and
+accumulates per-thread register tiles, the TPU version:
+
+* grids over (m/bm, n/bn) output tiles; Pallas pipelines the HBM→VMEM tile
+  copies automatically (the double-buffering is the hardware/compiler's job);
+* keeps ``y`` pre-transposed (d, n) so a feature chunk is a natural
+  (bk, bn) lane-major tile — no in-kernel transposes;
+* runs the k-loop as a ``fori_loop`` over feature chunks, accumulating an
+  (bm, bn) f32 tile on the VPU via a broadcasted (bm, bk, bn) core op —
+  the register-tile ``accumulate()`` analog (pairwise_distance_base.cuh:
+  ``core_op`` per register pair);
+* applies the metric's finalizer in the epilogue before the single store,
+  mirroring the fused ``fin_op`` epilog.
+
+Zero-padding of the feature axis is semantically safe for every metric here
+(all cores map (0,0) → 0 and the reducers are sum/max over nonnegative
+terms), so ragged d is handled by padding, not masking.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from raft_tpu.distance.distance_type import DistanceType
+
+__all__ = ["pallas_pairwise"]
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+def _round_up(a, b):
+    return _cdiv(a, b) * b
+
+
+# chunk cores operating on xc (bm, bk, 1) vs yc (1, bk, bn); must map
+# (0, 0) -> 0 so feature padding is a no-op.
+
+
+def _safe_div(num, den):
+    return num / jnp.where(den == 0.0, 1.0, den)
+
+
+def _kernel_spec(metric: DistanceType, p: float):
+    if metric == DistanceType.L1:
+        return dict(cores=(lambda a, b: jnp.abs(a - b),), red="sum",
+                    fin=lambda accs, d: accs[0])
+    if metric == DistanceType.L2Unexpanded:
+        return dict(cores=(lambda a, b: (a - b) * (a - b),), red="sum",
+                    fin=lambda accs, d: accs[0])
+    if metric == DistanceType.L2SqrtUnexpanded:
+        return dict(cores=(lambda a, b: (a - b) * (a - b),), red="sum",
+                    fin=lambda accs, d: jnp.sqrt(accs[0]))
+    if metric == DistanceType.Linf:
+        return dict(cores=(lambda a, b: jnp.abs(a - b),), red="max",
+                    fin=lambda accs, d: accs[0])
+    if metric == DistanceType.Canberra:
+        def canberra(a, b):
+            den = jnp.abs(a) + jnp.abs(b)
+            return jnp.where(den == 0.0, 0.0, jnp.abs(a - b) / jnp.where(den == 0.0, 1.0, den))
+        return dict(cores=(canberra,), red="sum", fin=lambda accs, d: accs[0])
+    if metric == DistanceType.LpUnexpanded:
+        return dict(cores=(lambda a, b: jnp.abs(a - b) ** p,), red="sum",
+                    fin=lambda accs, d: accs[0] ** (1.0 / p))
+    if metric == DistanceType.HammingUnexpanded:
+        return dict(cores=(lambda a, b: (a != b).astype(jnp.float32),), red="sum",
+                    fin=lambda accs, d: accs[0] / d)
+    if metric == DistanceType.KLDivergence:
+        def kl(a, b):
+            r = _safe_div(a, b)
+            return jnp.where(a > 0.0, a * jnp.log(jnp.where(r > 0.0, r, 1.0)), 0.0)
+        return dict(cores=(kl,), red="sum", fin=lambda accs, d: accs[0])
+    if metric == DistanceType.JensenShannon:
+        def js(a, b):
+            m = 0.5 * (a + b)
+            t1 = jnp.where(a > 0.0, a * jnp.log(_safe_div(a, m)), 0.0)
+            t2 = jnp.where(b > 0.0, b * jnp.log(_safe_div(b, m)), 0.0)
+            return 0.5 * (t1 + t2)
+        return dict(cores=(js,), red="sum",
+                    fin=lambda accs, d: jnp.sqrt(jnp.maximum(accs[0], 0.0)))
+    if metric == DistanceType.BrayCurtis:
+        return dict(cores=(lambda a, b: jnp.abs(a - b), lambda a, b: jnp.abs(a + b)),
+                    red="sum", fin=lambda accs, d: _safe_div(accs[0], accs[1]))
+    raise NotImplementedError(f"no pallas kernel for {metric}")
+
+
+def _pairwise_kernel(xt_ref, yt_ref, o_ref, *, spec, d_true, d_pad, bk):
+    """One (bm, bn) output tile. xt_ref: (d_pad, bm); yt_ref: (d_pad, bn).
+
+    Both operands are feature-major so the k-loop slices the *sublane*
+    dimension (8-aligned for f32) — dynamic lane-dim slices must be
+    128-aligned on TPU, which would force bk >= 128 and blow VMEM in the
+    broadcast below.
+    """
+    bm = xt_ref.shape[1]
+    bn = yt_ref.shape[1]
+    n_chunks = d_pad // bk
+    red = jnp.sum if spec["red"] == "sum" else jnp.max
+    n_acc = len(spec["cores"])
+
+    def body(c, accs):
+        xk = xt_ref[pl.dslice(c * bk, bk), :]         # (bk, bm)
+        yk = yt_ref[pl.dslice(c * bk, bk), :]         # (bk, bn)
+        xc = xk[:, :, None]                           # (bk, bm, 1)
+        yc = yk[:, None, :]                           # (bk, 1, bn)
+        new = []
+        for i, core in enumerate(spec["cores"]):
+            term = red(core(xc, yc), axis=0)          # (bm, bn)
+            if spec["red"] == "sum":
+                new.append(accs[i] + term)
+            else:
+                new.append(jnp.maximum(accs[i], term))
+        return tuple(new)
+
+    init = tuple(jnp.zeros((bm, bn), jnp.float32) for _ in range(n_acc))
+    accs = lax.fori_loop(0, n_chunks, body, init)
+    o_ref[:, :] = spec["fin"](accs, float(d_true)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("metric", "p", "bm", "bn", "bk", "interpret")
+)
+def pallas_pairwise(
+    x,
+    y,
+    metric: DistanceType,
+    *,
+    p: float = 2.0,
+    bm: int = 256,
+    bn: int = 256,
+    bk: int = 8,
+    interpret: bool | None = None,
+):
+    """Tiled VPU pairwise distances for unexpanded metrics."""
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    m, d = x.shape
+    n = y.shape[0]
+    spec = _kernel_spec(metric, p)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bk = max(8, _round_up(bk, 8))  # sublane-aligned dynamic slice (f32)
+    bm = min(bm, _round_up(m, 128))
+    bn = min(bn, _round_up(n, 128))
+    mp, np_, dp = _round_up(m, bm), _round_up(n, bn), _round_up(d, bk)
+    xtp = jnp.pad(x.T, ((0, dp - d), (0, mp - m)))
+    ytp = jnp.pad(y.T, ((0, dp - d), (0, np_ - n)))
+
+    kernel = functools.partial(
+        _pairwise_kernel, spec=spec, d_true=d, d_pad=dp, bk=bk
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((dp, bm), lambda i, j: (0, i)),
+            pl.BlockSpec((dp, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        interpret=interpret,
+    )(xtp, ytp)
+    return out[:m, :n]

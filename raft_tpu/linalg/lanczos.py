@@ -80,6 +80,12 @@ def lanczos_solver(matvec: Callable, n: int, n_components: int,
 
     Returns (eigenvalues (k,), eigenvectors (n, k)); eigenvalues ascending
     for ``smallest``, descending otherwise — matching the reference outputs.
+
+    ``max_iter`` and ``tol`` are accepted for signature parity with the
+    reference (linalg/detail/lanczos.cuh:745 computeSmallestEigenvectors)
+    but this is a single fixed-``ncv`` Lanczos pass, not a restarted
+    iteration: accuracy is controlled by ``ncv``. Raise ``ncv`` if the
+    returned pairs are unconverged.
     """
     if ncv is None or ncv <= 0:
         ncv = min(n, max(4 * n_components + 1, 32))

@@ -10,7 +10,7 @@ import pytest
 
 import raft_tpu
 from raft_tpu.core import logger, mdarray
-from raft_tpu.core.interruptible import Interruptible, InterruptedError
+from raft_tpu.core.interruptible import Interruptible, InterruptedException
 from raft_tpu.core.annotate import annotate, push_range, pop_range
 from raft_tpu.core.resources import Resources
 
@@ -73,7 +73,7 @@ class TestInterruptible:
 
     def test_cancel_self(self):
         Interruptible.get_token().cancel()
-        with pytest.raises(InterruptedError):
+        with pytest.raises(InterruptedException):
             Interruptible.yield_now()
         # token cleared after raising
         Interruptible.yield_now()
@@ -90,7 +90,7 @@ class TestInterruptible:
             for _ in range(200):
                 try:
                     Interruptible.yield_now()
-                except InterruptedError:
+                except InterruptedException:
                     errors.append("interrupted")
                     return
                 time.sleep(0.005)

@@ -1,0 +1,264 @@
+"""Distance engine tests — naive-oracle pattern mirroring the reference
+(cpp/test/distance/distance_base.cuh:33-57 naiveDistanceKernel + CompareApprox;
+cpp/test/distance/fused_l2_nn.cu)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance import (
+    DistanceType,
+    pairwise_distance,
+    fused_l2_nn,
+    fused_l2_nn_argmin,
+    haversine_distance,
+    pallas_pairwise,
+)
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles (deliberately naive, like the reference's naive kernels)
+# ---------------------------------------------------------------------------
+
+
+def naive_pairwise(x, y, metric, p=2.0):
+    m, d = x.shape
+    n = y.shape[0]
+    out = np.zeros((m, n), np.float64)
+    x = x.astype(np.float64)
+    y = y.astype(np.float64)
+    for i in range(m):
+        for j in range(n):
+            a, b = x[i], y[j]
+            if metric in (DistanceType.L2Expanded, DistanceType.L2Unexpanded):
+                out[i, j] = np.sum((a - b) ** 2)
+            elif metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+                out[i, j] = np.sqrt(np.sum((a - b) ** 2))
+            elif metric == DistanceType.CosineExpanded:
+                out[i, j] = 1 - a @ b / (np.linalg.norm(a) * np.linalg.norm(b))
+            elif metric == DistanceType.InnerProduct:
+                out[i, j] = a @ b
+            elif metric == DistanceType.CorrelationExpanded:
+                ac, bc = a - a.mean(), b - b.mean()
+                out[i, j] = 1 - ac @ bc / (np.linalg.norm(ac) * np.linalg.norm(bc))
+            elif metric == DistanceType.L1:
+                out[i, j] = np.sum(np.abs(a - b))
+            elif metric == DistanceType.Linf:
+                out[i, j] = np.max(np.abs(a - b))
+            elif metric == DistanceType.Canberra:
+                den = np.abs(a) + np.abs(b)
+                t = np.where(den == 0, 0.0, np.abs(a - b) / np.where(den == 0, 1, den))
+                out[i, j] = np.sum(t)
+            elif metric == DistanceType.LpUnexpanded:
+                out[i, j] = np.sum(np.abs(a - b) ** p) ** (1 / p)
+            elif metric == DistanceType.HellingerExpanded:
+                out[i, j] = np.sqrt(max(0.0, 1 - np.sum(np.sqrt(a * b))))
+            elif metric == DistanceType.HammingUnexpanded:
+                out[i, j] = np.mean(a != b)
+            elif metric == DistanceType.KLDivergence:
+                mask = a > 0
+                out[i, j] = np.sum(a[mask] * np.log(a[mask] / b[mask]))
+            elif metric == DistanceType.JensenShannon:
+                mm = 0.5 * (a + b)
+                t1 = np.where(a > 0, a * np.log(np.where(a > 0, a, 1) / mm), 0)
+                t2 = np.where(b > 0, b * np.log(np.where(b > 0, b, 1) / mm), 0)
+                out[i, j] = np.sqrt(max(0.0, 0.5 * np.sum(t1 + t2)))
+            elif metric == DistanceType.BrayCurtis:
+                out[i, j] = np.sum(np.abs(a - b)) / np.sum(np.abs(a + b))
+            elif metric == DistanceType.RusselRaoExpanded:
+                out[i, j] = (d - a @ b) / d
+            elif metric == DistanceType.JaccardExpanded:
+                inter = a @ b
+                out[i, j] = 1 - inter / (a.sum() + b.sum() - inter)
+            elif metric == DistanceType.DiceExpanded:
+                out[i, j] = 1 - 2 * (a @ b) / (a.sum() + b.sum())
+            else:
+                raise NotImplementedError(metric)
+    return out
+
+
+GENERAL_METRICS = [
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.CosineExpanded,
+    DistanceType.InnerProduct,
+    DistanceType.CorrelationExpanded,
+    DistanceType.L1,
+    DistanceType.L2Unexpanded,
+    DistanceType.L2SqrtUnexpanded,
+    DistanceType.Linf,
+    DistanceType.Canberra,
+    DistanceType.LpUnexpanded,
+    DistanceType.HammingUnexpanded,
+    DistanceType.BrayCurtis,
+]
+
+PROB_METRICS = [  # require probability-simplex rows
+    DistanceType.HellingerExpanded,
+    DistanceType.KLDivergence,
+    DistanceType.JensenShannon,
+]
+
+BOOL_METRICS = [
+    DistanceType.RusselRaoExpanded,
+    DistanceType.JaccardExpanded,
+    DistanceType.DiceExpanded,
+]
+
+
+@pytest.mark.parametrize("metric", GENERAL_METRICS)
+@pytest.mark.parametrize("shape", [(33, 17, 5), (64, 128, 32)])
+def test_pairwise_general(metric, shape, rng_np):
+    m, n, d = shape
+    x = rng_np.standard_normal((m, d)).astype(np.float32)
+    y = rng_np.standard_normal((n, d)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric, p=3.0))
+    want = naive_pairwise(x, y, metric, p=3.0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("metric", PROB_METRICS)
+def test_pairwise_prob(metric, rng_np):
+    m, n, d = 20, 30, 16
+    x = rng_np.random((m, d)).astype(np.float32) + 0.01
+    y = rng_np.random((n, d)).astype(np.float32) + 0.01
+    x /= x.sum(1, keepdims=True)
+    y /= y.sum(1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric))
+    want = naive_pairwise(x, y, metric)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("metric", BOOL_METRICS)
+def test_pairwise_bool(metric, rng_np):
+    m, n, d = 25, 18, 40
+    x = (rng_np.random((m, d)) > 0.5).astype(np.float32)
+    y = (rng_np.random((n, d)) > 0.5).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric))
+    want = naive_pairwise(x, y, metric)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_haversine(rng_np):
+    x = np.stack(
+        [rng_np.uniform(-np.pi / 2, np.pi / 2, 10), rng_np.uniform(-np.pi, np.pi, 10)], 1
+    ).astype(np.float32)
+    y = np.stack(
+        [rng_np.uniform(-np.pi / 2, np.pi / 2, 7), rng_np.uniform(-np.pi, np.pi, 7)], 1
+    ).astype(np.float32)
+    got = np.asarray(haversine_distance(x, y))
+    for i in range(10):
+        for j in range(7):
+            la1, lo1 = x[i]
+            la2, lo2 = y[j]
+            a = (
+                np.sin((la1 - la2) / 2) ** 2
+                + np.cos(la1) * np.cos(la2) * np.sin((lo1 - lo2) / 2) ** 2
+            )
+            want = 2 * np.arcsin(np.sqrt(a))
+            np.testing.assert_allclose(got[i, j], want, rtol=1e-4, atol=1e-5)
+
+
+def test_metric_string_aliases(rng_np):
+    x = rng_np.standard_normal((8, 4)).astype(np.float32)
+    a = np.asarray(pairwise_distance(x, x, "euclidean"))
+    b = np.asarray(pairwise_distance(x, x, DistanceType.L2SqrtUnexpanded))
+    np.testing.assert_allclose(a, b)
+
+
+def test_fin_op_fused(rng_np):
+    # epsilon-neighborhood style fused threshold
+    x = rng_np.standard_normal((16, 8)).astype(np.float32)
+    got = np.asarray(
+        pairwise_distance(x, x, DistanceType.L2Unexpanded, fin_op=lambda d: d < 1.0)
+    )
+    want = naive_pairwise(x, x, DistanceType.L2Unexpanded) < 1.0
+    assert got.dtype == np.bool_
+    np.testing.assert_array_equal(got, want)
+
+
+def test_blocked_matches_unblocked(rng_np):
+    x = rng_np.standard_normal((37, 9)).astype(np.float32)
+    y = rng_np.standard_normal((21, 9)).astype(np.float32)
+    a = np.asarray(pairwise_distance(x, y, DistanceType.L1))
+    b = np.asarray(pairwise_distance(x, y, DistanceType.L1, block_m=16))
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+PALLAS_METRICS = [
+    DistanceType.L1,
+    DistanceType.L2Unexpanded,
+    DistanceType.L2SqrtUnexpanded,
+    DistanceType.Linf,
+    DistanceType.Canberra,
+    DistanceType.LpUnexpanded,
+    DistanceType.HammingUnexpanded,
+    DistanceType.BrayCurtis,
+]
+
+
+@pytest.mark.parametrize("metric", PALLAS_METRICS)
+def test_pallas_pairwise(metric, rng_np):
+    # interpret mode on CPU; ragged shapes exercise the padding path
+    m, n, d = 19, 35, 13
+    x = rng_np.standard_normal((m, d)).astype(np.float32)
+    y = rng_np.standard_normal((n, d)).astype(np.float32)
+    got = np.asarray(pallas_pairwise(x, y, metric, p=3.0, bm=8, bn=128, bk=4))
+    want = naive_pairwise(x, y, metric, p=3.0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_prob_metrics(rng_np):
+    m, n, d = 16, 20, 8
+    x = rng_np.random((m, d)).astype(np.float32) + 0.01
+    y = rng_np.random((n, d)).astype(np.float32) + 0.01
+    x /= x.sum(1, keepdims=True)
+    y /= y.sum(1, keepdims=True)
+    for metric in (DistanceType.KLDivergence, DistanceType.JensenShannon):
+        got = np.asarray(pallas_pairwise(x, y, metric, bm=8, bn=128, bk=4))
+        want = naive_pairwise(x, y, metric)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fused L2 NN (reference cpp/test/distance/fused_l2_nn.cu)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(57, 13, 8), (128, 300, 32)])
+@pytest.mark.parametrize("sqrt", [False, True])
+def test_fused_l2_nn(shape, sqrt, rng_np):
+    m, n, d = shape
+    x = rng_np.standard_normal((m, d)).astype(np.float32)
+    y = rng_np.standard_normal((n, d)).astype(np.float32)
+    minv, mini = fused_l2_nn(x, y, sqrt=sqrt, block_n=64)
+    d2 = naive_pairwise(x, y, DistanceType.L2Unexpanded)
+    if sqrt:
+        d2 = np.sqrt(d2)
+    np.testing.assert_array_equal(np.asarray(mini), d2.argmin(1))
+    np.testing.assert_allclose(np.asarray(minv), d2.min(1), rtol=1e-4, atol=1e-4)
+
+
+def test_fused_l2_nn_masked(rng_np):
+    # connect_components-style exclusion: mask out same-color pairs
+    m, n, d = 40, 40, 4
+    x = rng_np.standard_normal((m, d)).astype(np.float32)
+    colors = rng_np.integers(0, 3, m)
+    import jax.numpy as jnp
+
+    cj = jnp.asarray(colors)
+
+    def mask_op(rows, cols):
+        return cj[rows] != cj[cols]
+
+    minv, mini = fused_l2_nn(x, x, mask_op=mask_op, block_n=16)
+    d2 = naive_pairwise(x, x, DistanceType.L2Unexpanded)
+    d2[colors[:, None] == colors[None, :]] = np.inf
+    np.testing.assert_array_equal(np.asarray(mini), d2.argmin(1))
+
+
+def test_fused_l2_nn_argmin_matches(rng_np):
+    x = rng_np.standard_normal((31, 6)).astype(np.float32)
+    y = rng_np.standard_normal((17, 6)).astype(np.float32)
+    idx = np.asarray(fused_l2_nn_argmin(x, y))
+    d2 = naive_pairwise(x, y, DistanceType.L2Unexpanded)
+    np.testing.assert_array_equal(idx, d2.argmin(1))
