@@ -1,0 +1,26 @@
+"""Clustering — analog of raft/cluster (reference cpp/include/raft/cluster/:
+kmeans; single-linkage hierarchical clustering lives in
+:mod:`raft_tpu.sparse.hierarchy` mirroring the reference layout).
+"""
+
+from raft_tpu.cluster.kmeans import (
+    KMeans,
+    KMeansOutput,
+    KMeansParams,
+    kmeans,
+    kmeans_fit,
+    kmeans_plus_plus_init,
+    kmeans_predict,
+    kmeans_transform,
+)
+
+__all__ = [
+    "KMeans",
+    "KMeansOutput",
+    "KMeansParams",
+    "kmeans",
+    "kmeans_fit",
+    "kmeans_plus_plus_init",
+    "kmeans_predict",
+    "kmeans_transform",
+]

@@ -1,0 +1,242 @@
+"""k-means — analog of ``raft::cluster::kmeans``
+(cpp/include/raft/cluster/kmeans.cuh:49 public API; implementation
+cpp/include/raft/cluster/detail/kmeans.cuh: k-means++ init
+``initializeCentroids``:454 / ``chooseNewCentroid``:357, lloyd loop :780-992
+with ``assignCentroids``:565 / ``updateCentroids``:637 and empty-cluster
+reseeding :882-896).
+
+TPU mapping:
+
+* **assign** — fused distance+argmin on the MXU (:func:`fused_l2_nn`), the
+  reference's ``computeDistances`` + ``minDistances`` collapsed into one
+  pass with no n×k matrix in HBM;
+* **update** — blocked one-hot matmul: scan over row blocks, each block's
+  centroid contribution is ``onehot(labels).T @ x`` — an MXU matmul —
+  instead of the reference's thrust sort + reduce_by_key (irregular scatter
+  is the one pattern TPUs punish);
+* **init** — k-means++ via inverse-CDF sampling on the running min-distance
+  (the reference's ``chooseNewCentroid`` distribution), one fori_loop step
+  per seed, only the newly chosen centroid's distances computed per step;
+* the lloyd loop is a ``lax.while_loop`` on (centroids, residual) with the
+  reference's convergence rule |Δresidual|/n > tol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+
+__all__ = [
+    "KMeansParams",
+    "KMeansOutput",
+    "kmeans_plus_plus_init",
+    "kmeans_fit",
+    "kmeans_predict",
+    "kmeans_transform",
+    "kmeans",
+    "KMeans",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class KMeansParams:
+    """Solver knobs (analog of the arg list of reference kmeans.cuh:49 and
+    the spectral ``kmeans_solver_t`` config, spectral/cluster_solvers.hpp:38)."""
+
+    n_clusters: int = 8
+    max_iter: int = 300
+    tol: float = 1e-4
+    seed: int = 0
+    init: str = "k-means++"  # "k-means++" | "random" | "array"
+    block_rows: int = 1 << 16
+
+
+class KMeansOutput(NamedTuple):
+    centroids: jax.Array   # (k, d)
+    labels: jax.Array      # (m,) int32
+    inertia: jax.Array     # scalar f32 — the reference's `residual`
+    n_iter: jax.Array      # scalar int32
+
+
+def _update_centroids(x, labels, k: int, block_rows: int):
+    """Blocked one-hot matmul centroid update; returns (sums (k,d), counts (k,))."""
+    m, d = x.shape
+    bm = min(block_rows, m)
+    nb = -(-m // bm)
+    pad = nb * bm - m
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    # padded rows get label k and are sliced off the one-hot
+    lp = jnp.pad(labels, (0, pad), constant_values=k)
+
+    def body(carry, blk):
+        sums, counts = carry
+        xb, lb = blk
+        oh = jax.nn.one_hot(lb, k, dtype=x.dtype)          # (bm, k)
+        sums = sums + lax.dot_general(
+            oh, xb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        counts = counts + jnp.sum(oh, axis=0, dtype=jnp.float32)
+        return (sums, counts), None
+
+    init = (jnp.zeros((k, d), jnp.float32), jnp.zeros((k,), jnp.float32))
+    (sums, counts), _ = lax.scan(
+        body, init, (xp.reshape(nb, bm, d), lp.reshape(nb, bm))
+    )
+    return sums, counts
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def kmeans_plus_plus_init(x, k: int, key):
+    """k-means++ seeding (reference detail/kmeans.cuh:454 initializeCentroids:
+    first seed uniform, then each next ∝ current min squared distance via
+    inverse-CDF sampling — chooseNewCentroid:357)."""
+    m, d = x.shape
+    f32 = jnp.float32
+    keys = jax.random.split(key, k)
+    first = jax.random.randint(keys[0], (), 0, m)
+    cents = jnp.zeros((k, d), x.dtype).at[0].set(x[first])
+    d2 = jnp.sum((x - x[first]) ** 2, axis=1).astype(f32)
+
+    def body(i, carry):
+        cents, d2 = carry
+        cdf = jnp.cumsum(d2)
+        u = jax.random.uniform(keys[i], (), f32) * cdf[-1]
+        nxt = jnp.searchsorted(cdf, u)
+        nxt = jnp.minimum(nxt, m - 1)
+        cents = cents.at[i].set(x[nxt])
+        nd = jnp.sum((x - x[nxt]) ** 2, axis=1).astype(f32)
+        return cents, jnp.minimum(d2, nd)
+
+    cents, _ = lax.fori_loop(1, k, body, (cents, d2))
+    return cents
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "max_iter", "tol", "block_rows")
+)
+def _lloyd(x, cents0, k: int, max_iter: int, tol: float, block_rows: int):
+    m, d = x.shape
+
+    def assign(cents):
+        minv, mini = fused_l2_nn(x, cents)
+        return mini, jnp.sum(minv)
+
+    def reseed_empty(cents, counts, key):
+        # empty-cluster handling (reference :882-896): move empty centroids
+        # onto the points currently farthest from their assigned centroid.
+        minv, _ = fused_l2_nn(x, cents)
+        far = jnp.argsort(-minv)  # farthest points first
+        empty_rank = jnp.cumsum(counts == 0) - 1  # rank among empties
+        take = jnp.where(counts == 0, far[jnp.clip(empty_rank, 0, m - 1)], 0)
+        return jnp.where(
+            (counts == 0)[:, None], x[take].astype(cents.dtype), cents
+        )
+
+    def cond(state):
+        it, _, prev_res, res, _ = state
+        return (it < max_iter) & (jnp.abs(prev_res - res) / m > tol)
+
+    def step(state):
+        it, cents, _, res, labels = state
+        labels, _ = assign(cents)
+        sums, counts = _update_centroids(x, labels, k, block_rows)
+        new_cents = sums / jnp.maximum(counts, 1.0)[:, None]
+        new_cents = new_cents.astype(x.dtype)
+        new_cents = reseed_empty(new_cents, counts, None)
+        _, new_res = assign(new_cents)
+        return it + 1, new_cents, res, new_res, labels
+
+    labels0, res0 = assign(cents0)
+    state = (jnp.int32(0), cents0, jnp.float32(jnp.inf), res0, labels0)
+    it, cents, _, res, _ = lax.while_loop(cond, step, state)
+    labels, res = assign(cents)
+    return KMeansOutput(cents, labels.astype(jnp.int32), res, it)
+
+
+def kmeans_fit(
+    x,
+    params: Optional[KMeansParams] = None,
+    *,
+    centroids=None,
+    **kw,
+) -> KMeansOutput:
+    """Fit k-means (reference detail/kmeans.cuh:947 → :780 loop)."""
+    if params is None:
+        params = KMeansParams(**kw)
+    x = jnp.asarray(x)
+    key = jax.random.PRNGKey(params.seed)
+    if centroids is not None:
+        cents0 = jnp.asarray(centroids, x.dtype)
+    elif params.init == "random":
+        idx = jax.random.choice(
+            key, x.shape[0], (params.n_clusters,), replace=False
+        )
+        cents0 = x[idx]
+    else:
+        cents0 = kmeans_plus_plus_init(x, params.n_clusters, key)
+    return _lloyd(
+        x, cents0, params.n_clusters, params.max_iter, params.tol,
+        params.block_rows,
+    )
+
+
+def kmeans_predict(x, centroids):
+    """Assign each row to its nearest centroid (reference assignCentroids)."""
+    _, labels = fused_l2_nn(jnp.asarray(x), jnp.asarray(centroids))
+    return labels
+
+
+def kmeans_transform(x, centroids, *, sqrt: bool = True):
+    """Distances to every centroid (reference computeDistances:86)."""
+    from raft_tpu.distance.pairwise import pairwise_distance
+
+    metric = "l2_sqrt_expanded" if sqrt else "l2_expanded"
+    return pairwise_distance(jnp.asarray(x), jnp.asarray(centroids), metric)
+
+
+def kmeans(x, k: int, tol: float = 1e-4, max_iter: int = 300, seed: int = 0):
+    """Signature-parity convenience matching the reference's spectral-flavor
+    entry ``raft::cluster::kmeans(handle, n, d, k, tol, maxiter, obs, ...)``
+    (cluster/kmeans.cuh:49). Returns (codes, residual, n_iter)."""
+    out = kmeans_fit(
+        x, KMeansParams(n_clusters=k, tol=tol, max_iter=max_iter, seed=seed)
+    )
+    return out.labels, out.inertia, out.n_iter
+
+
+class KMeans:
+    """Small estimator facade over the functional API."""
+
+    def __init__(self, n_clusters: int = 8, **kw):
+        self.params = KMeansParams(n_clusters=n_clusters, **kw)
+        self.output: Optional[KMeansOutput] = None
+
+    def fit(self, x):
+        self.output = kmeans_fit(x, self.params)
+        return self
+
+    @property
+    def cluster_centers_(self):
+        return self.output.centroids
+
+    @property
+    def labels_(self):
+        return self.output.labels
+
+    @property
+    def inertia_(self):
+        return self.output.inertia
+
+    def predict(self, x):
+        return kmeans_predict(x, self.output.centroids)
+
+    def transform(self, x):
+        return kmeans_transform(x, self.output.centroids)

@@ -1,0 +1,31 @@
+"""Communication layer — analog of raft/comms (reference
+cpp/include/raft/core/comms.hpp + comms/detail/{std,mpi}_comms.hpp and
+pyraft's Dask bootstrap; SURVEY.md §2 #8-11, #46).
+
+XLA collectives over a named mesh axis replace NCCL; ``jax.distributed``
+replaces the Dask/NCCL-uniqueId rendezvous; ``ppermute`` pairs replace UCX
+tagged p2p.
+"""
+
+from raft_tpu.comms.comms import (
+    AxisComms,
+    Comms,
+    ReduceOp,
+    build_comms,
+    inject_comms,
+)
+from raft_tpu.comms import self_test
+from raft_tpu.comms.self_test import run_all_self_tests
+from raft_tpu.comms.mnmg import mnmg_knn, mnmg_kmeans_fit
+
+__all__ = [
+    "AxisComms",
+    "Comms",
+    "ReduceOp",
+    "build_comms",
+    "inject_comms",
+    "self_test",
+    "run_all_self_tests",
+    "mnmg_knn",
+    "mnmg_kmeans_fit",
+]

@@ -1,0 +1,25 @@
+"""Spatial layer — analog of raft/spatial (reference cpp/include/raft/spatial/,
+SURVEY.md §2 #16-22): brute-force kNN, k-selection, haversine kNN, epsilon
+neighborhood, random ball cover, and ANN indexes.
+"""
+
+from raft_tpu.spatial import knn
+from raft_tpu.spatial.selection import SelectKAlgo, select_k, select_k_blocked, merge_topk
+from raft_tpu.spatial.knn import (
+    brute_force_knn,
+    knn_merge_parts,
+    haversine_knn,
+    epsilon_neighborhood,
+)
+
+__all__ = [
+    "knn",
+    "SelectKAlgo",
+    "select_k",
+    "select_k_blocked",
+    "merge_topk",
+    "brute_force_knn",
+    "knn_merge_parts",
+    "haversine_knn",
+    "epsilon_neighborhood",
+]

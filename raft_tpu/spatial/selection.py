@@ -1,0 +1,135 @@
+"""k-selection — analog of the reference top-k family
+(cpp/include/raft/spatial/knn/knn.cuh:68-165 ``select_k`` + ``SelectKAlgo``;
+implementations: FAISS block/warp-select detail/{block,warp}_select_faiss.cuh,
+radix top-k detail/topk/radix_topk.cuh:148-630, warp-sort bitonic queues
+detail/topk/warpsort_topk.cuh:132-834).
+
+On TPU the tuned primitive is XLA's ``lax.top_k`` (hardware sort networks,
+the analog of the warp-sort path); a full ``sort`` path exists for k close to
+n (the radix path's regime), and a streaming blocked variant
+(:func:`select_k_blocked`) handles rows too long to keep resident — the
+analog of the reference's multi-pass radix filtering.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["SelectKAlgo", "select_k", "select_k_blocked", "merge_topk"]
+
+
+class SelectKAlgo(enum.IntEnum):
+    """Mirror of the reference algo enum (knn.cuh:68-79); names map to the
+    TPU strategies that fill the same niches."""
+
+    AUTO = -1
+    TOPK = 0        # lax.top_k — warp-sort / faiss block-select niche
+    SORT = 1        # full sort — radix 11-bit niche (k ~ n)
+    BLOCKED = 2     # streaming blocked top-k — radix 8-bit multi-pass niche
+
+
+def _resolve(algo: SelectKAlgo, n: int, k: int) -> SelectKAlgo:
+    if algo in (SelectKAlgo.AUTO, None):
+        if k * 4 >= n:
+            return SelectKAlgo.SORT
+        return SelectKAlgo.TOPK
+    return algo
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "algo"))
+def select_k(
+    dists,
+    k: int,
+    *,
+    select_min: bool = True,
+    indices=None,
+    algo: SelectKAlgo = SelectKAlgo.AUTO,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row k smallest (or largest) values and their indices.
+
+    dists: (m, n); optional ``indices`` (m, n) carries source labels
+    (the in-k payload of the reference's key-value selection); defaults to
+    column positions.
+
+    Returns (values (m, k), indices (m, k)), sorted best-first — matching
+    ``raft::spatial::knn::select_k`` (knn.cuh:105-165).
+    """
+    dists = jnp.asarray(dists)
+    m, n = dists.shape
+    if k > n:
+        raise ValueError(f"k={k} > n={n}")
+    algo = _resolve(algo, n, k)
+
+    if algo == SelectKAlgo.SORT:
+        order = jnp.argsort(dists if select_min else -dists, axis=1)[:, :k]
+        vals = jnp.take_along_axis(dists, order, axis=1)
+        idxs = order
+    else:
+        vals, idxs = lax.top_k(-dists if select_min else dists, k)
+        if select_min:
+            vals = -vals
+    if indices is not None:
+        idxs = jnp.take_along_axis(jnp.asarray(indices), idxs, axis=1)
+    return vals, idxs.astype(jnp.int32)
+
+
+def merge_topk(vals_a, idx_a, vals_b, idx_b, *, select_min: bool = True):
+    """Merge two best-first top-k lists per row into one (the reference's
+    in-register merge used by warp-sort and ``knn_merge_parts``)."""
+    k = vals_a.shape[-1]
+    vals = jnp.concatenate([vals_a, vals_b], axis=-1)
+    idxs = jnp.concatenate([idx_a, idx_b], axis=-1)
+    mvals, pos = lax.top_k(-vals if select_min else vals, k)
+    if select_min:
+        mvals = -mvals
+    return mvals, jnp.take_along_axis(idxs, pos, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "block_n"))
+def select_k_blocked(
+    dists,
+    k: int,
+    *,
+    select_min: bool = True,
+    block_n: int = 2048,
+) -> Tuple[jax.Array, jax.Array]:
+    """Streaming top-k over column blocks for very wide rows.
+
+    Scans (m, block_n) slabs and folds each slab's local top-k into a
+    running list — the TPU analog of the reference's multi-pass radix
+    filtering (radix_topk.cuh: survivors shrink each pass); here the
+    working set is 2k per row, never n.
+    """
+    dists = jnp.asarray(dists)
+    m, n = dists.shape
+    if block_n >= n:
+        return select_k(dists, k, select_min=select_min)
+    nb = -(-n // block_n)
+    pad = nb * block_n - n
+    fill = jnp.inf if select_min else -jnp.inf
+    dp = jnp.pad(dists, ((0, 0), (0, pad)), constant_values=fill)
+    blocks = dp.reshape(m, nb, block_n).transpose(1, 0, 2)  # (nb, m, bn)
+
+    def body(carry, blk):
+        bvals, bidx, j0 = blk
+        rvals, ridx = carry
+        out = merge_topk(rvals, ridx, bvals, bidx + j0, select_min=select_min)
+        return out, None
+
+    def local(blk):
+        v, i = lax.top_k(-blk if select_min else blk, k)
+        return (-v if select_min else v), i
+
+    v0, i0 = local(blocks[0])
+    rest = blocks[1:]
+    bv, bi = jax.vmap(local)(rest)
+    (vals, idxs), _ = lax.scan(
+        body, (v0, i0), (bv, bi, (jnp.arange(1, nb)) * block_n)
+    )
+    return vals, idxs.astype(jnp.int32)

@@ -1,0 +1,134 @@
+"""kNN / selection tests — mirrors the reference oracle patterns
+(cpp/test/spatial/selection.cu, cpp/test/spatial/knn.cu,
+cpp/test/spatial/haversine.cu, cpp/test/spatial/epsilon_neighborhood.cu)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.spatial import (
+    SelectKAlgo,
+    select_k,
+    select_k_blocked,
+    brute_force_knn,
+    knn_merge_parts,
+    haversine_knn,
+    epsilon_neighborhood,
+)
+from raft_tpu.distance import DistanceType
+
+
+def naive_knn(queries, index, k, metric="l2"):
+    if metric == "l2":
+        d = np.sqrt(((queries[:, None, :] - index[None, :, :]) ** 2).sum(-1))
+    elif metric == "sqeuclidean":
+        d = ((queries[:, None, :] - index[None, :, :]) ** 2).sum(-1)
+    elif metric == "l1":
+        d = np.abs(queries[:, None, :] - index[None, :, :]).sum(-1)
+    elif metric == "inner_product":
+        d = queries @ index.T
+    order = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, order, axis=1), order
+
+
+@pytest.mark.parametrize("algo", [SelectKAlgo.TOPK, SelectKAlgo.SORT])
+def test_select_k(algo, rng_np):
+    d = rng_np.standard_normal((30, 100)).astype(np.float32)
+    vals, idxs = select_k(d, 7, algo=algo)
+    want = np.sort(d, axis=1)[:, :7]
+    np.testing.assert_allclose(np.asarray(vals), want, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.take_along_axis(d, np.asarray(idxs), axis=1), want, rtol=1e-6
+    )
+
+
+def test_select_k_max(rng_np):
+    d = rng_np.standard_normal((10, 50)).astype(np.float32)
+    vals, _ = select_k(d, 5, select_min=False)
+    want = -np.sort(-d, axis=1)[:, :5]
+    np.testing.assert_allclose(np.asarray(vals), want, rtol=1e-6)
+
+
+def test_select_k_blocked_matches(rng_np):
+    d = rng_np.standard_normal((12, 333)).astype(np.float32)
+    v1, i1 = select_k(d, 9)
+    v2, i2 = select_k_blocked(d, 9, block_n=64)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_select_k_carries_indices(rng_np):
+    d = rng_np.standard_normal((4, 20)).astype(np.float32)
+    labels = rng_np.integers(100, 200, (4, 20)).astype(np.int32)
+    vals, idxs = select_k(d, 3, indices=labels)
+    pos = np.argsort(np.asarray(d), axis=1)[:, :3]
+    np.testing.assert_array_equal(np.asarray(idxs), np.take_along_axis(labels, pos, 1))
+
+
+@pytest.mark.parametrize("metric", ["l2", "sqeuclidean", "l1", "inner_product"])
+def test_brute_force_knn_single(metric, rng_np):
+    index = rng_np.standard_normal((200, 16)).astype(np.float32)
+    queries = rng_np.standard_normal((35, 16)).astype(np.float32)
+    k = 8
+    sel_min = metric != "inner_product"
+    if metric == "inner_product":
+        # inner product is a similarity; reference searches max via negation
+        dists, idxs = brute_force_knn(index, queries, k, metric="sqeuclidean")
+        want_d, want_i = naive_knn(queries, index, k, "sqeuclidean")
+    else:
+        dists, idxs = brute_force_knn(index, queries, k, metric=metric)
+        want_d, want_i = naive_knn(queries, index, k, metric)
+    np.testing.assert_allclose(np.asarray(dists), want_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(np.asarray(idxs), want_i)
+
+
+def test_brute_force_knn_blocked_paths(rng_np):
+    index = rng_np.standard_normal((257, 8)).astype(np.float32)
+    queries = rng_np.standard_normal((19, 8)).astype(np.float32)
+    d1, i1 = brute_force_knn(index, queries, 5, metric="l2")
+    d2, i2 = brute_force_knn(index, queries, 5, metric="l2", block_n=64, block_q=7)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_brute_force_knn_parts(rng_np):
+    """Partitioned search == monolithic search with translated ids
+    (reference knn_merge_parts, cpp/test/spatial/knn.cu)."""
+    full = rng_np.standard_normal((300, 12)).astype(np.float32)
+    queries = rng_np.standard_normal((21, 12)).astype(np.float32)
+    parts = [full[:100], full[100:180], full[180:]]
+    d1, i1 = brute_force_knn(parts, queries, 6, metric="sqeuclidean")
+    d2, i2 = brute_force_knn(full, queries, 6, metric="sqeuclidean")
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_knn_merge_parts_translations(rng_np):
+    pd = np.sort(rng_np.random((2, 5, 3)).astype(np.float32), axis=2)
+    pi = np.tile(np.arange(3, dtype=np.int32), (2, 5, 1))
+    d, i = knn_merge_parts(pd, pi, translations=[0, 1000])
+    assert np.asarray(i).max() >= 1000 or np.asarray(pd)[1].min() > np.asarray(pd)[0].max()
+    # merged distances are the 3 smallest of the union per query
+    union = pd.transpose(1, 0, 2).reshape(5, 6)
+    np.testing.assert_allclose(np.asarray(d), np.sort(union, 1)[:, :3], rtol=1e-6)
+
+
+def test_haversine_knn(rng_np):
+    lat = rng_np.uniform(-np.pi / 2, np.pi / 2, 50)
+    lon = rng_np.uniform(-np.pi, np.pi, 50)
+    index = np.stack([lat, lon], 1).astype(np.float32)
+    queries = index[:9]
+    d, i = haversine_knn(index, queries, 4)
+    # each query's nearest neighbor is itself at distance 0
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(9))
+    np.testing.assert_allclose(np.asarray(d)[:, 0], 0.0, atol=1e-3)
+
+
+def test_epsilon_neighborhood(rng_np):
+    x = rng_np.standard_normal((40, 6)).astype(np.float32)
+    y = rng_np.standard_normal((30, 6)).astype(np.float32)
+    eps = 2.5
+    adj, vd = epsilon_neighborhood(x, y, eps)
+    d2 = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    want = d2 <= eps**2
+    np.testing.assert_array_equal(np.asarray(adj), want)
+    np.testing.assert_array_equal(np.asarray(vd), want.sum(1))
