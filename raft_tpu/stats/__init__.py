@@ -1,0 +1,57 @@
+"""Statistics — analog of raft/stats (reference cpp/include/raft/stats/,
+~7.4 kLoC; SURVEY.md §2 #40): summary stats, clustering metrics (external
+pair-counting + silhouette/dispersion), regression metrics, information
+criteria, trustworthiness.
+"""
+
+from raft_tpu.stats.summary import (
+    mean,
+    stddev,
+    vars_,
+    meanvar,
+    minmax,
+    sum_,
+    cov,
+    histogram,
+    weighted_mean,
+    row_weighted_mean,
+    col_weighted_mean,
+)
+from raft_tpu.stats.clustering_metrics import (
+    contingency_matrix,
+    adjusted_rand_index,
+    rand_index,
+    mutual_info_score,
+    entropy,
+    homogeneity_score,
+    completeness_score,
+    v_measure,
+    silhouette_score,
+    silhouette_samples,
+    batched_silhouette_score,
+    dispersion,
+    kl_divergence,
+)
+from raft_tpu.stats.regression_metrics import (
+    accuracy,
+    r2_score,
+    RegressionMetrics,
+    regression_metrics,
+    mean_squared_error,
+    CriterionType,
+    information_criterion,
+)
+from raft_tpu.stats.trustworthiness import trustworthiness_score
+
+__all__ = [
+    "mean", "stddev", "vars_", "meanvar", "minmax", "sum_", "cov",
+    "histogram", "weighted_mean", "row_weighted_mean", "col_weighted_mean",
+    "contingency_matrix", "adjusted_rand_index", "rand_index",
+    "mutual_info_score", "entropy", "homogeneity_score",
+    "completeness_score", "v_measure", "silhouette_score",
+    "silhouette_samples", "batched_silhouette_score", "dispersion",
+    "kl_divergence",
+    "accuracy", "r2_score", "RegressionMetrics", "regression_metrics",
+    "mean_squared_error", "CriterionType", "information_criterion",
+    "trustworthiness_score",
+]

@@ -1,0 +1,228 @@
+"""External/internal clustering metrics — analog of
+cpp/include/raft/stats/: contingency_matrix.cuh, adjusted_rand_index.cuh,
+rand_index.cuh, mutual_info_score.cuh, entropy.cuh, homogeneity_score.cuh,
+completeness_score.cuh, v_measure.cuh, silhouette_score.cuh (+ batched),
+dispersion.cuh, kl_divergence.cuh.
+
+All pair-counting metrics derive from one contingency matrix built as a
+one-hot matmul (MXU) — the reference's custom binning kernels
+(detail/contingency_matrix.cuh) collapse into that single pattern on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.pairwise import pairwise_distance
+
+__all__ = [
+    "contingency_matrix",
+    "adjusted_rand_index",
+    "rand_index",
+    "mutual_info_score",
+    "entropy",
+    "homogeneity_score",
+    "completeness_score",
+    "v_measure",
+    "silhouette_score",
+    "silhouette_samples",
+    "batched_silhouette_score",
+    "dispersion",
+    "kl_divergence",
+]
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes_true", "n_classes_pred"))
+def contingency_matrix(
+    y_true, y_pred, n_classes_true: int, n_classes_pred: Optional[int] = None
+):
+    """C[i, j] = #{samples with true label i and predicted label j}
+    (reference stats/contingency_matrix.cuh). Labels must be [0, n_classes).
+    """
+    if n_classes_pred is None:
+        n_classes_pred = n_classes_true
+    a = jax.nn.one_hot(jnp.asarray(y_true), n_classes_true, dtype=jnp.float32)
+    b = jax.nn.one_hot(jnp.asarray(y_pred), n_classes_pred, dtype=jnp.float32)
+    return lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(jnp.int32)
+
+
+def _comb2(x):
+    x = x.astype(jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32)
+    return x * (x - 1.0) / 2.0
+
+
+def adjusted_rand_index(y_true, y_pred, n_classes: int):
+    """ARI from the contingency matrix (reference stats/adjusted_rand_index.cuh)."""
+    c = contingency_matrix(y_true, y_pred, n_classes).astype(jnp.float32)
+    n = jnp.sum(c)
+    sum_comb_c = jnp.sum(_comb2(c))
+    a = jnp.sum(c, axis=1)
+    b = jnp.sum(c, axis=0)
+    sum_comb_a = jnp.sum(_comb2(a))
+    sum_comb_b = jnp.sum(_comb2(b))
+    exp = sum_comb_a * sum_comb_b / _comb2(n)
+    mx = 0.5 * (sum_comb_a + sum_comb_b)
+    return (sum_comb_c - exp) / jnp.where(mx - exp == 0, 1.0, mx - exp)
+
+
+def rand_index(y_true, y_pred):
+    """Unadjusted Rand index by direct pair counting
+    (reference stats/rand_index.cuh computes a/b over all n² pairs)."""
+    y_true = jnp.asarray(y_true)
+    y_pred = jnp.asarray(y_pred)
+    n = y_true.shape[0]
+    same_t = y_true[:, None] == y_true[None, :]
+    same_p = y_pred[:, None] == y_pred[None, :]
+    agree = (same_t == same_p).astype(jnp.float32)
+    total_pairs = n * (n - 1) / 2.0
+    upper = jnp.sum(jnp.triu(agree, k=1))
+    return upper / total_pairs
+
+
+def entropy(labels, n_classes: int):
+    """Shannon entropy (nats) of a label vector (reference stats/entropy.cuh)."""
+    oh = jax.nn.one_hot(jnp.asarray(labels), n_classes, dtype=jnp.float32)
+    p = jnp.sum(oh, axis=0) / oh.shape[0]
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def mutual_info_score(y_true, y_pred, n_classes: int):
+    """MI (nats) from the contingency matrix (reference stats/mutual_info_score.cuh)."""
+    c = contingency_matrix(y_true, y_pred, n_classes).astype(jnp.float32)
+    n = jnp.sum(c)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    terms = jnp.where(
+        pij > 0, pij * (jnp.log(jnp.where(pij > 0, pij, 1.0)) - jnp.log(pi * pj + 1e-30)), 0.0
+    )
+    return jnp.sum(terms)
+
+
+def homogeneity_score(y_true, y_pred, n_classes: int):
+    """1 - H(C|K)/H(C) (reference stats/homogeneity_score.cuh)."""
+    h_c = entropy(y_true, n_classes)
+    mi = mutual_info_score(y_true, y_pred, n_classes)
+    return jnp.where(h_c == 0, 1.0, mi / h_c)
+
+
+def completeness_score(y_true, y_pred, n_classes: int):
+    """Symmetric counterpart (reference stats/completeness_score.cuh)."""
+    return homogeneity_score(y_pred, y_true, n_classes)
+
+
+def v_measure(y_true, y_pred, n_classes: int, beta: float = 1.0):
+    """Harmonic mean of homogeneity and completeness (stats/v_measure.cuh)."""
+    h = homogeneity_score(y_true, y_pred, n_classes)
+    c = completeness_score(y_true, y_pred, n_classes)
+    denom = beta * h + c
+    return jnp.where(denom == 0, 0.0, (1 + beta) * h * c / denom)
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "metric"))
+def silhouette_samples(x, labels, n_clusters: int, metric="l2_sqrt_expanded"):
+    """Per-sample silhouette (reference stats/silhouette_score.cuh):
+    s(i) = (b_i - a_i)/max(a_i, b_i) with a = mean intra-cluster distance,
+    b = min over other clusters of mean distance. One n×n distance matrix +
+    a one-hot matmul produces all per-cluster distance sums on the MXU."""
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels)
+    n = x.shape[0]
+    d = pairwise_distance(x, x, metric)
+    oh = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)       # (n, k)
+    sums = lax.dot_general(
+        d, oh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )                                                                # (n, k)
+    counts = jnp.sum(oh, axis=0)                                      # (k,)
+    own = counts[labels]
+    a = jnp.where(
+        own > 1,
+        jnp.take_along_axis(sums, labels[:, None], axis=1)[:, 0] / jnp.maximum(own - 1, 1),
+        0.0,
+    )
+    mean_other = sums / jnp.maximum(counts, 1.0)[None, :]
+    mean_other = jnp.where(
+        (jnp.arange(n_clusters)[None, :] == labels[:, None]) | (counts[None, :] == 0),
+        jnp.inf,
+        mean_other,
+    )
+    b = jnp.min(mean_other, axis=1)
+    s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    return s
+
+
+def silhouette_score(x, labels, n_clusters: int, metric="l2_sqrt_expanded"):
+    return jnp.mean(silhouette_samples(x, labels, n_clusters, metric))
+
+
+def batched_silhouette_score(
+    x, labels, n_clusters: int, metric="l2_sqrt_expanded", batch_size: int = 4096
+):
+    """Chunked variant for large n (reference
+    stats/detail/batched/silhouette_score.cuh): processes query batches
+    against the full dataset so only (batch, n) tiles are live."""
+    import numpy as np
+
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels)
+    n = x.shape[0]
+    oh = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
+    counts = jnp.sum(oh, axis=0)
+
+    @functools.partial(jax.jit, static_argnames=())
+    def batch_sums(xb):
+        d = pairwise_distance(xb, x, metric)
+        return lax.dot_general(
+            d, oh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    total = jnp.float32(0.0)
+    for s0 in range(0, n, batch_size):
+        s1 = min(s0 + batch_size, n)
+        sums = batch_sums(x[s0:s1])
+        lb = labels[s0:s1]
+        own = counts[lb]
+        a = jnp.where(
+            own > 1,
+            jnp.take_along_axis(sums, lb[:, None], axis=1)[:, 0] / jnp.maximum(own - 1, 1),
+            0.0,
+        )
+        mean_other = sums / jnp.maximum(counts, 1.0)[None, :]
+        mean_other = jnp.where(
+            (jnp.arange(n_clusters)[None, :] == lb[:, None]) | (counts[None, :] == 0),
+            jnp.inf,
+            mean_other,
+        )
+        b = jnp.min(mean_other, axis=1)
+        sb = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+        total = total + jnp.sum(sb)
+    return total / n
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None):
+    """Between-cluster dispersion: sqrt(Σ_k n_k ||μ_k - μ||²)
+    (reference stats/dispersion.cuh). Returns (dispersion, global_centroid)."""
+    centroids = jnp.asarray(centroids)
+    sizes = jnp.asarray(cluster_sizes, jnp.float32)
+    if global_centroid is None:
+        global_centroid = jnp.sum(
+            centroids * sizes[:, None], axis=0
+        ) / jnp.sum(sizes)
+    diff = centroids - global_centroid[None, :]
+    disp = jnp.sqrt(jnp.sum(sizes * jnp.sum(diff * diff, axis=1)))
+    return disp, global_centroid
+
+
+def kl_divergence(p, q):
+    """Σ p log(p/q) over flattened inputs (reference stats/kl_divergence.cuh)."""
+    p = jnp.asarray(p)
+    q = jnp.asarray(q)
+    ratio = jnp.where((p > 0) & (q > 0), p / jnp.where(q > 0, q, 1.0), 1.0)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(ratio), 0.0))

@@ -1,0 +1,92 @@
+"""Regression/classification metrics + information criteria — analog of
+cpp/include/raft/stats/: accuracy.cuh, r2_score.cuh, regression_metrics.cuh,
+information_criterion.cuh, mean_squared_error.cuh.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "accuracy",
+    "r2_score",
+    "RegressionMetrics",
+    "regression_metrics",
+    "mean_squared_error",
+    "CriterionType",
+    "information_criterion",
+]
+
+
+def accuracy(predictions, ref_predictions):
+    """Fraction of exact matches (reference stats/accuracy.cuh)."""
+    p = jnp.asarray(predictions)
+    r = jnp.asarray(ref_predictions)
+    return jnp.mean((p == r).astype(jnp.float32))
+
+
+def r2_score(y, y_hat):
+    """Coefficient of determination (reference stats/r2_score.cuh)."""
+    y = jnp.asarray(y)
+    y_hat = jnp.asarray(y_hat)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / jnp.where(ss_tot == 0, 1.0, ss_tot)
+
+
+class RegressionMetrics(NamedTuple):
+    mean_abs_error: jax.Array
+    mean_squared_error: jax.Array
+    median_abs_error: jax.Array
+
+
+def regression_metrics(predictions, ref_predictions) -> RegressionMetrics:
+    """MAE / MSE / MedAE triple (reference stats/regression_metrics.cuh)."""
+    p = jnp.asarray(predictions, jnp.float32)
+    r = jnp.asarray(ref_predictions, jnp.float32)
+    err = p - r
+    return RegressionMetrics(
+        jnp.mean(jnp.abs(err)),
+        jnp.mean(err * err),
+        jnp.median(jnp.abs(err)),
+    )
+
+
+def mean_squared_error(a, b, weight: float = 1.0):
+    """Weighted MSE (reference linalg/mean_squared_error.cuh)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    return jnp.mean((a - b) ** 2) * weight
+
+
+class CriterionType(enum.IntEnum):
+    """Mirror of reference IC_Type (stats/information_criterion.cuh)."""
+
+    AIC = 0
+    AICc = 1
+    BIC = 2
+
+
+def information_criterion(
+    log_likelihood, ic_type: CriterionType, n_params: int, n_samples: int
+):
+    """Batched information criteria from log-likelihoods
+    (reference stats/information_criterion.cuh / detail impl):
+    AIC = -2ll + 2p; AICc adds the small-sample correction; BIC uses p·ln n.
+    """
+    ll = jnp.asarray(log_likelihood)
+    ic_type = CriterionType(ic_type)
+    base = -2.0 * ll
+    if ic_type == CriterionType.AIC:
+        pen = 2.0 * n_params
+    elif ic_type == CriterionType.AICc:
+        pen = 2.0 * n_params + (
+            2.0 * n_params * (n_params + 1.0) / max(n_samples - n_params - 1.0, 1.0)
+        )
+    else:
+        pen = n_params * jnp.log(jnp.float32(n_samples))
+    return base + pen

@@ -1,0 +1,129 @@
+"""Summary statistics — analog of the reference's per-column stats prims
+(cpp/include/raft/stats/: mean.cuh, stddev.cuh, meanvar.cuh, minmax.cuh,
+sum.cuh, cov.cuh, histogram.cuh, weighted_mean.cuh).
+
+All are XLA reductions/matmuls; cov rides the MXU. Column-wise semantics
+(axis=0) match the reference's default row-major sample × feature layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "mean",
+    "stddev",
+    "vars_",
+    "meanvar",
+    "minmax",
+    "sum_",
+    "cov",
+    "histogram",
+    "weighted_mean",
+    "row_weighted_mean",
+    "col_weighted_mean",
+]
+
+
+def mean(x, axis: int = 0, sample: bool = False):
+    """Column means (reference stats/mean.cuh; ``sample`` divides by n-1)."""
+    x = jnp.asarray(x)
+    n = x.shape[axis]
+    s = jnp.sum(x, axis=axis)
+    return s / (n - 1 if sample else n)
+
+
+def vars_(x, mu=None, axis: int = 0, sample: bool = True):
+    """Column variances (reference stats/stddev.cuh vars)."""
+    x = jnp.asarray(x)
+    if mu is None:
+        mu = mean(x, axis=axis)
+    n = x.shape[axis]
+    d = x - jnp.expand_dims(mu, axis)
+    return jnp.sum(d * d, axis=axis) / (n - 1 if sample else n)
+
+
+def stddev(x, mu=None, axis: int = 0, sample: bool = True):
+    """Column standard deviations (reference stats/stddev.cuh)."""
+    return jnp.sqrt(vars_(x, mu=mu, axis=axis, sample=sample))
+
+
+def meanvar(x, axis: int = 0, sample: bool = True):
+    """Single-pass mean+variance (reference stats/meanvar.cuh)."""
+    x = jnp.asarray(x)
+    mu = mean(x, axis=axis)
+    return mu, vars_(x, mu=mu, axis=axis, sample=sample)
+
+
+def minmax(x, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Column minima and maxima (reference stats/minmax.cuh)."""
+    x = jnp.asarray(x)
+    return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
+
+
+def sum_(x, axis: int = 0):
+    """Column sums (reference stats/sum.cuh)."""
+    return jnp.sum(jnp.asarray(x), axis=axis)
+
+
+def cov(x, mu=None, *, sample: bool = True, stable: bool = True):
+    """Covariance matrix (d, d) of row-sample data (reference stats/cov.cuh).
+
+    ``stable`` subtracts the mean before the MXU gram (the reference's
+     stable=true path); the unstable path uses E[xxT] - mu muT.
+    """
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    denom = n - 1 if sample else n
+    if mu is None:
+        mu = mean(x, axis=0)
+    if stable:
+        xc = x - mu[None, :]
+        g = lax.dot_general(
+            xc, xc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return g / denom
+    g = lax.dot_general(
+        x, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return g / denom - jnp.outer(mu, mu) * (n / denom)
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def histogram(x, n_bins: int, lower=None, upper=None):
+    """Per-column histogram: out[b, c] counts rows of column c in bin b
+    (reference stats/detail/histogram.cuh — the many CUDA binning strategies
+    collapse into one one-hot matmul on TPU)."""
+    x = jnp.asarray(x)
+    if x.ndim == 1:
+        x = x[:, None]
+    lo = jnp.min(x) if lower is None else jnp.asarray(lower, x.dtype)
+    hi = jnp.max(x) if upper is None else jnp.asarray(upper, x.dtype)
+    width = jnp.maximum((hi - lo) / n_bins, jnp.finfo(jnp.float32).tiny)
+    bins = jnp.clip(((x - lo) / width).astype(jnp.int32), 0, n_bins - 1)
+    oh = jax.nn.one_hot(bins, n_bins, dtype=jnp.int32, axis=0)  # (B, n, c)
+    return jnp.sum(oh, axis=1)
+
+
+def weighted_mean(x, weights, axis: int = 0):
+    """Weighted mean along ``axis`` (reference stats/weighted_mean.cuh)."""
+    x = jnp.asarray(x)
+    w = jnp.asarray(weights)
+    wsum = jnp.sum(w)
+    return jnp.tensordot(w, x, axes=([0], [axis])) / wsum
+
+
+def row_weighted_mean(x, weights):
+    """Per-row mean weighted across columns (rowWeightedMean)."""
+    return weighted_mean(jnp.asarray(x), weights, axis=1)
+
+
+def col_weighted_mean(x, weights):
+    """Per-column mean weighted across rows (colWeightedMean)."""
+    return weighted_mean(jnp.asarray(x), weights, axis=0)

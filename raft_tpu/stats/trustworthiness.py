@@ -1,0 +1,49 @@
+"""Trustworthiness of an embedding — analog of
+cpp/include/raft/stats/trustworthiness_score.cuh:39 (kNN-based, metric-
+parameterized; the reference runs brute-force kNN in the embedded space and
+ranks in the original space).
+
+T = 1 - 2/(n·k·(2n - 3k - 1)) · Σ_i Σ_{j ∈ kNN_emb(i)} max(0, rank_orig(i,j) - k)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.spatial.knn import brute_force_knn
+
+__all__ = ["trustworthiness_score"]
+
+
+@functools.partial(jax.jit, static_argnames=("n_neighbors", "metric"))
+def _trust_impl(x, x_embedded, n_neighbors: int, metric):
+    n = x.shape[0]
+    k = n_neighbors
+    # ranks in the ORIGINAL space: rank[i, j] = position of j in i's
+    # distance-sorted neighbor list (self excluded, hence the -1)
+    d_orig = pairwise_distance(x, x, metric)
+    order = jnp.argsort(d_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.int32)
+    ranks = jax.vmap(
+        lambda r, o: r.at[o].set(jnp.arange(n, dtype=jnp.int32))
+    )(ranks, order)
+
+    # kNN in the EMBEDDED space (self excluded: search k+1, drop col 0)
+    d_emb = pairwise_distance(x_embedded, x_embedded, metric)
+    _, nn_emb = jax.lax.top_k(-d_emb, k + 1)
+    nn_emb = nn_emb[:, 1:]
+
+    r = jnp.take_along_axis(ranks, nn_emb, axis=1)
+    penalty = jnp.maximum(0, r - k)
+    t = 1.0 - 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0)) * jnp.sum(penalty)
+    return t
+
+
+def trustworthiness_score(x, x_embedded, n_neighbors: int = 5, metric="l2_sqrt_expanded"):
+    return _trust_impl(
+        jnp.asarray(x), jnp.asarray(x_embedded), n_neighbors, metric
+    )

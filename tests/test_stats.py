@@ -1,0 +1,210 @@
+"""Stats tests — host-reference oracle pattern (reference cpp/test/stats/*:
+CPU/closed-form expected values + tolerance matchers)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+from raft_tpu.stats import CriterionType
+
+
+def test_mean_stddev_meanvar(rng_np):
+    x = rng_np.standard_normal((200, 7)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(stats.mean(x)), x.mean(0), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats.stddev(x)), x.std(0, ddof=1), rtol=1e-4, atol=1e-5
+    )
+    mu, var = stats.meanvar(x)
+    np.testing.assert_allclose(np.asarray(var), x.var(0, ddof=1), rtol=1e-4, atol=1e-5)
+
+
+def test_minmax_sum(rng_np):
+    x = rng_np.standard_normal((50, 4)).astype(np.float32)
+    mn, mx = stats.minmax(x)
+    np.testing.assert_array_equal(np.asarray(mn), x.min(0))
+    np.testing.assert_array_equal(np.asarray(mx), x.max(0))
+    np.testing.assert_allclose(np.asarray(stats.sum_(x)), x.sum(0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("stable", [True, False])
+def test_cov(stable, rng_np):
+    x = rng_np.standard_normal((300, 5)).astype(np.float32)
+    got = np.asarray(stats.cov(x, stable=stable))
+    want = np.cov(x, rowvar=False)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_histogram(rng_np):
+    x = rng_np.random((1000, 3)).astype(np.float32)
+    h = np.asarray(stats.histogram(x, 10, lower=0.0, upper=1.0))
+    assert h.shape == (10, 3)
+    np.testing.assert_array_equal(h.sum(0), [1000, 1000, 1000])
+    for c in range(3):
+        want, _ = np.histogram(x[:, c], bins=10, range=(0, 1))
+        np.testing.assert_array_equal(h[:, c], want)
+
+
+def test_weighted_mean(rng_np):
+    x = rng_np.standard_normal((40, 6)).astype(np.float32)
+    w = rng_np.random(40).astype(np.float32)
+    got = np.asarray(stats.col_weighted_mean(x, w))
+    want = (x * w[:, None]).sum(0) / w.sum()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    wr = rng_np.random(6).astype(np.float32)
+    got = np.asarray(stats.row_weighted_mean(x, wr))
+    np.testing.assert_allclose(got, (x * wr[None, :]).sum(1) / wr.sum(), rtol=1e-4, atol=1e-5)
+
+
+# -- clustering metrics ------------------------------------------------------
+
+
+def test_contingency_matrix():
+    yt = np.array([0, 0, 1, 1, 2, 2])
+    yp = np.array([0, 0, 1, 2, 2, 2])
+    c = np.asarray(stats.contingency_matrix(yt, yp, 3))
+    want = np.array([[2, 0, 0], [0, 1, 1], [0, 0, 2]])
+    np.testing.assert_array_equal(c, want)
+
+
+def naive_ari(yt, yp):
+    classes_t = np.unique(yt)
+    classes_p = np.unique(yp)
+    c = np.array([[(np.logical_and(yt == i, yp == j)).sum() for j in classes_p] for i in classes_t], float)
+    comb = lambda x: x * (x - 1) / 2
+    sum_c = comb(c).sum()
+    a = comb(c.sum(1)).sum()
+    b = comb(c.sum(0)).sum()
+    n = comb(len(yt))
+    exp = a * b / n
+    return (sum_c - exp) / ((a + b) / 2 - exp)
+
+
+def test_adjusted_rand_index(rng_np):
+    yt = rng_np.integers(0, 4, 100)
+    yp = rng_np.integers(0, 4, 100)
+    got = float(stats.adjusted_rand_index(yt, yp, 4))
+    np.testing.assert_allclose(got, naive_ari(yt, yp), rtol=1e-4, atol=1e-5)
+    # perfect agreement
+    np.testing.assert_allclose(float(stats.adjusted_rand_index(yt, yt, 4)), 1.0, atol=1e-5)
+
+
+def test_rand_index(rng_np):
+    yt = rng_np.integers(0, 3, 40)
+    yp = rng_np.integers(0, 3, 40)
+    got = float(stats.rand_index(yt, yp))
+    n = len(yt)
+    agree = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            agree += (yt[i] == yt[j]) == (yp[i] == yp[j])
+    np.testing.assert_allclose(got, agree / (n * (n - 1) / 2), rtol=1e-5)
+
+
+def test_entropy_uniform():
+    labels = np.repeat(np.arange(4), 25)
+    np.testing.assert_allclose(float(stats.entropy(labels, 4)), np.log(4), rtol=1e-5)
+
+
+def test_mutual_info_and_vmeasure(rng_np):
+    yt = rng_np.integers(0, 3, 200)
+    # identical labelings: MI = H, homogeneity = completeness = v = 1
+    mi = float(stats.mutual_info_score(yt, yt, 3))
+    h = float(stats.entropy(yt, 3))
+    np.testing.assert_allclose(mi, h, rtol=1e-4)
+    np.testing.assert_allclose(float(stats.v_measure(yt, yt, 3)), 1.0, atol=1e-5)
+    np.testing.assert_allclose(float(stats.homogeneity_score(yt, yt, 3)), 1.0, atol=1e-5)
+    # independent labelings have low v-measure
+    yp = rng_np.integers(0, 3, 200)
+    assert float(stats.v_measure(yt, yp, 3)) < 0.2
+
+
+def naive_silhouette(x, labels):
+    n = len(x)
+    d = np.sqrt(((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+    s = np.zeros(n)
+    for i in range(n):
+        own = labels == labels[i]
+        if own.sum() > 1:
+            a = d[i][own & (np.arange(n) != i)].mean()
+        else:
+            s[i] = 0.0
+            continue
+        b = np.inf
+        for c in np.unique(labels):
+            if c == labels[i]:
+                continue
+            mask = labels == c
+            if mask.any():
+                b = min(b, d[i][mask].mean())
+        s[i] = (b - a) / max(a, b)
+    return s
+
+
+def test_silhouette(rng_np):
+    x = np.concatenate(
+        [rng_np.standard_normal((30, 4)) + 5, rng_np.standard_normal((30, 4)) - 5]
+    ).astype(np.float32)
+    labels = np.repeat([0, 1], 30)
+    got = np.asarray(stats.silhouette_samples(x, labels, 2))
+    want = naive_silhouette(x, labels)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    score = float(stats.silhouette_score(x, labels, 2))
+    np.testing.assert_allclose(score, want.mean(), rtol=1e-3)
+    batched = float(stats.batched_silhouette_score(x, labels, 2, batch_size=16))
+    np.testing.assert_allclose(batched, want.mean(), rtol=1e-3)
+
+
+def test_dispersion(rng_np):
+    cents = rng_np.standard_normal((4, 3)).astype(np.float32)
+    sizes = np.array([10, 20, 30, 40], np.int32)
+    disp, gc = stats.dispersion(cents, sizes)
+    mu = (cents * sizes[:, None]).sum(0) / sizes.sum()
+    want = np.sqrt((sizes * ((cents - mu) ** 2).sum(1)).sum())
+    np.testing.assert_allclose(float(disp), want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gc), mu, rtol=1e-5)
+
+
+def test_kl_divergence():
+    p = np.array([0.5, 0.3, 0.2], np.float32)
+    q = np.array([0.4, 0.4, 0.2], np.float32)
+    want = (p * np.log(p / q)).sum()
+    np.testing.assert_allclose(float(stats.kl_divergence(p, q)), want, rtol=1e-5)
+
+
+# -- regression / IC ---------------------------------------------------------
+
+
+def test_accuracy_r2(rng_np):
+    a = rng_np.integers(0, 2, 100)
+    np.testing.assert_allclose(float(stats.accuracy(a, a)), 1.0)
+    y = rng_np.standard_normal(100).astype(np.float32)
+    yh = y + 0.1 * rng_np.standard_normal(100).astype(np.float32)
+    got = float(stats.r2_score(y, yh))
+    want = 1 - ((y - yh) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+    np.testing.assert_allclose(got, want, rtol=1e-3)
+
+
+def test_regression_metrics(rng_np):
+    p = rng_np.standard_normal(50).astype(np.float32)
+    r = rng_np.standard_normal(50).astype(np.float32)
+    m = stats.regression_metrics(p, r)
+    np.testing.assert_allclose(float(m.mean_abs_error), np.abs(p - r).mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(m.mean_squared_error), ((p - r) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(m.median_abs_error), np.median(np.abs(p - r)), rtol=1e-5)
+
+
+def test_information_criterion():
+    ll = np.array([-100.0, -50.0], np.float32)
+    aic = np.asarray(stats.information_criterion(ll, CriterionType.AIC, 3, 1000))
+    np.testing.assert_allclose(aic, -2 * ll + 6)
+    bic = np.asarray(stats.information_criterion(ll, CriterionType.BIC, 3, 1000))
+    np.testing.assert_allclose(bic, -2 * ll + 3 * np.log(1000), rtol=1e-6)
+
+
+def test_trustworthiness_perfect_embedding(rng_np):
+    x = rng_np.standard_normal((60, 8)).astype(np.float32)
+    t = float(stats.trustworthiness_score(x, x, n_neighbors=5))
+    np.testing.assert_allclose(t, 1.0, atol=1e-5)
+    # random embedding scores lower
+    bad = rng_np.standard_normal((60, 2)).astype(np.float32)
+    assert float(stats.trustworthiness_score(x, bad, n_neighbors=5)) < 0.95
