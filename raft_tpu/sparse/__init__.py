@@ -1,0 +1,31 @@
+"""Sparse suite — analog of raft/sparse (reference cpp/include/raft/sparse/,
+~13.3 kLoC; SURVEY.md §2 #25-32): COO/CSR containers, structural ops,
+sparse linalg, sparse distances/kNN, kNN-graph, MST, connected components,
+single-linkage hierarchical clustering.
+
+TPU representation: static-capacity padded arrays as pytrees (see coo.py).
+"""
+
+from raft_tpu.sparse.coo import COO, CSR, coo_from_dense, csr_from_coo, coo_from_csr
+from raft_tpu.sparse import op
+from raft_tpu.sparse import linalg
+from raft_tpu.sparse.distance import (
+    densify_rows,
+    sparse_pairwise_distance,
+    sparse_brute_force_knn,
+)
+from raft_tpu.sparse.knn_graph import knn_graph
+
+__all__ = [
+    "COO",
+    "CSR",
+    "coo_from_dense",
+    "csr_from_coo",
+    "coo_from_csr",
+    "op",
+    "linalg",
+    "densify_rows",
+    "sparse_pairwise_distance",
+    "sparse_brute_force_knn",
+    "knn_graph",
+]

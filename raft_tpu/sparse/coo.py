@@ -1,0 +1,142 @@
+"""Sparse containers — analog of the reference sparse core
+(cpp/include/raft/sparse/coo.hpp ``class COO``, csr.hpp, detail/{coo,csr}.cuh).
+
+TPU-first representation: **static-capacity padded arrays** registered as
+pytrees. XLA requires static shapes, so where the reference reallocates
+``rmm::device_uvector``s to the exact nnz, we carry a fixed capacity plus a
+dynamic ``nnz`` count; padding entries sit at the tail with ``val = 0`` and
+``row = col = 0`` and every op either masks on ``arange(cap) < nnz`` or is
+padding-neutral (sums). This is the sparse analog of the dense library's
+pad-to-block-multiple convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["COO", "CSR", "coo_from_dense", "csr_from_coo", "coo_from_csr"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class COO:
+    """Coordinate-format sparse matrix (reference sparse/coo.hpp:29 COO<T>).
+
+    rows/cols/vals have static capacity >= nnz; entries past ``nnz`` are
+    padding (row=col=0, val=0).
+    """
+
+    rows: jax.Array          # (cap,) int32
+    cols: jax.Array          # (cap,) int32
+    vals: jax.Array          # (cap,) T
+    nnz: jax.Array           # () int32 — dynamic count
+    shape: Tuple[int, int] = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.shape[0]
+
+    def valid_mask(self) -> jax.Array:
+        return jnp.arange(self.capacity) < self.nnz
+
+    def to_dense(self) -> jax.Array:
+        m, n = self.shape
+        v = jnp.where(self.valid_mask(), self.vals, 0)
+        return jnp.zeros((m, n), self.vals.dtype).at[self.rows, self.cols].add(v)
+
+    def degree(self) -> jax.Array:
+        """Row counts (reference sparse/linalg/degree.cuh coo_degree)."""
+        m, _ = self.shape
+        ones = jnp.where(self.valid_mask(), 1, 0)
+        return jnp.zeros((m,), jnp.int32).at[self.rows].add(ones)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CSR:
+    """Compressed-sparse-row matrix (reference sparse/csr.hpp).
+
+    indptr is exact (n_rows+1); indices/data are padded to capacity.
+    """
+
+    indptr: jax.Array        # (m+1,) int32
+    indices: jax.Array       # (cap,) int32
+    data: jax.Array          # (cap,) T
+    nnz: jax.Array           # () int32
+    shape: Tuple[int, int] = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def capacity(self) -> int:
+        return self.indices.shape[0]
+
+    def valid_mask(self) -> jax.Array:
+        return jnp.arange(self.capacity) < self.nnz
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to per-entry row ids (reference csr_to_coo,
+        sparse/convert/coo.cuh): row[k] = #rows whose range starts <= k."""
+        cap = self.capacity
+        pos = jnp.arange(cap)
+        return (
+            jnp.searchsorted(self.indptr, pos, side="right").astype(jnp.int32)
+            - 1
+        )
+
+    def to_dense(self) -> jax.Array:
+        m, n = self.shape
+        v = jnp.where(self.valid_mask(), self.data, 0)
+        return (
+            jnp.zeros((m, n), self.data.dtype)
+            .at[self.row_ids(), self.indices]
+            .add(v)
+        )
+
+
+def coo_from_dense(x, capacity: Optional[int] = None) -> COO:
+    """Host-side constructor from a dense matrix (test/convert utility)."""
+    x = np.asarray(x)
+    r, c = np.nonzero(x)
+    v = x[r, c]
+    nnz = len(v)
+    cap = capacity or max(nnz, 1)
+    assert cap >= nnz
+    pad = cap - nnz
+    return COO(
+        jnp.asarray(np.concatenate([r, np.zeros(pad, np.int64)]).astype(np.int32)),
+        jnp.asarray(np.concatenate([c, np.zeros(pad, np.int64)]).astype(np.int32)),
+        jnp.asarray(np.concatenate([v, np.zeros(pad, v.dtype)])),
+        jnp.int32(nnz),
+        x.shape,
+    )
+
+
+def csr_from_coo(coo: COO, *, sorted_rows: bool = False) -> CSR:
+    """COO→CSR (reference sparse/convert/csr.cuh sorted_coo_to_csr).
+
+    Requires/establishes row-sorted order; padding stays at the tail.
+    """
+    from raft_tpu.sparse.op import coo_sort
+
+    if not sorted_rows:
+        coo = coo_sort(coo)
+    m, n = coo.shape
+    counts = (
+        jnp.zeros((m,), jnp.int32)
+        .at[coo.rows]
+        .add(jnp.where(coo.valid_mask(), 1, 0))
+    )
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
+    )
+    return CSR(indptr, coo.cols, coo.vals, coo.nnz, coo.shape)
+
+
+def coo_from_csr(csr: CSR) -> COO:
+    """CSR→COO (reference sparse/convert/coo.cuh csr_to_coo)."""
+    rows = jnp.where(csr.valid_mask(), csr.row_ids(), 0).astype(jnp.int32)
+    return COO(rows, csr.indices, csr.data, csr.nnz, csr.shape)

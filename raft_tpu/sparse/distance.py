@@ -1,0 +1,128 @@
+"""Sparse pairwise distances + sparse kNN — analog of
+raft/sparse/distance (cpp/include/raft/sparse/distance/: generalized
+load-balanced COO SpMV with dense-smem/hash strategies,
+detail/coo_spmv.cuh:48-205, dispatch distance.cuh) and
+raft/sparse/selection/knn.cuh:54 (batched sparse brute-force kNN).
+
+TPU strategy (SURVEY.md §7 step 8): **blocked densification**. TPUs have no
+shared-memory hash tables; for the moderate sparsity these algorithms serve,
+scattering a CSR row block into a dense (block, d) VMEM-resident tile and
+riding the dense MXU/VPU metric engine beats any emulated hash join. Each
+(query block × index block) pair densifies once and reuses the dense
+pairwise kernels, so every metric of the dense engine is available sparsely
+— a superset of the reference's sparse metric table.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.distance_type import DistanceType, resolve_metric
+from raft_tpu.sparse.coo import CSR
+from raft_tpu.spatial.knn import _block_dist
+from raft_tpu.spatial.selection import merge_topk
+
+__all__ = ["densify_rows", "sparse_pairwise_distance", "sparse_brute_force_knn"]
+
+
+def densify_rows(csr: CSR, row_start, block_rows: int) -> jax.Array:
+    """Scatter rows [row_start, row_start + block_rows) into a dense block
+    (the 'dense strategy' analog, coo_spmv_strategies/dense_smem_strategy.cuh).
+    ``row_start`` may be traced."""
+    d = csr.shape[1]
+    rows = csr.row_ids()
+    in_blk = (
+        csr.valid_mask() & (rows >= row_start) & (rows < row_start + block_rows)
+    )
+    local = jnp.where(in_blk, rows - row_start, block_rows)  # OOB -> dropped
+    vals = jnp.where(in_blk, csr.data, 0)
+    dense = jnp.zeros((block_rows + 1, d), csr.data.dtype)
+    dense = dense.at[local, csr.indices].add(vals)
+    return dense[:block_rows]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("metric", "p", "block_m")
+)
+def sparse_pairwise_distance(
+    a: CSR,
+    b: CSR,
+    metric="l2_sqrt_expanded",
+    *,
+    p: float = 2.0,
+    block_m: int = 512,
+):
+    """Full (m, n) distance matrix between CSR row sets
+    (reference sparse/distance/distance.cuh pairwiseDistance dispatch)."""
+    metric = resolve_metric(metric)
+    m = a.shape[0]
+    n = b.shape[0]
+    bd = densify_rows(b, 0, n)  # index side densified once
+
+    bm = min(block_m, m)
+    nb = -(-m // bm)
+
+    def one(i):
+        ad = densify_rows(a, i * bm, bm)
+        return _block_dist(ad, bd, metric, p)
+
+    out = lax.map(one, jnp.arange(nb))  # (nb, bm, n)
+    return out.reshape(nb * bm, n)[:m]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "p", "block_q", "block_n")
+)
+def sparse_brute_force_knn(
+    index: CSR,
+    queries: CSR,
+    k: int,
+    *,
+    metric="l2_sqrt_expanded",
+    p: float = 2.0,
+    block_q: int = 512,
+    block_n: int = 2048,
+):
+    """Batched sparse brute-force kNN (reference sparse/selection/knn.cuh:54
+    ``brute_force_knn`` — there a tiling over both matrices with a
+    faiss-select merge; here densified blocks + streaming top-k merge).
+
+    Returns (dists (m, k), indices (m, k)).
+    """
+    metric = resolve_metric(metric)
+    m = queries.shape[0]
+    n = index.shape[0]
+    bn = max(k, min(block_n, n))
+    nb = -(-n // bn)
+    bq = min(block_q, m)
+    qb = -(-m // bq)
+
+    def one_qblock(qi):
+        qd = densify_rows(queries, qi * bq, bq)
+
+        def body(carry, j):
+            rv, ri = carry
+            yd = densify_rows(index, j * bn, bn)
+            dmat = _block_dist(qd, yd, metric, p)
+            cols = j * bn + jnp.arange(bn)[None, :]
+            dmat = jnp.where(cols < n, dmat, jnp.inf)
+            bv, bi = lax.top_k(-dmat, k)
+            return merge_topk(rv, ri, -bv, bi + j * bn, select_min=True), None
+
+        init = (
+            jnp.full((bq, k), jnp.inf, jnp.float32),
+            jnp.zeros((bq, k), jnp.int32),
+        )
+        (vals, idxs), _ = lax.scan(body, init, jnp.arange(nb))
+        return vals, idxs
+
+    vals, idxs = lax.map(one_qblock, jnp.arange(qb))
+    return (
+        vals.reshape(qb * bq, k)[:m],
+        idxs.reshape(qb * bq, k)[:m].astype(jnp.int32),
+    )

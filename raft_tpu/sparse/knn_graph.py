@@ -1,0 +1,49 @@
+"""kNN-graph builder — analog of
+cpp/include/raft/sparse/selection/knn_graph.cuh:48 ``knn_graph``:
+dense input rows → symmetric COO graph of k-nearest-neighbor edges (the
+input to MST/single-linkage).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.coo import COO
+from raft_tpu.sparse.op import coo_sort
+from raft_tpu.spatial.knn import brute_force_knn
+
+__all__ = ["knn_graph"]
+
+
+def knn_graph(
+    x,
+    k: int,
+    *,
+    metric="l2_sqrt_expanded",
+    symmetrize: bool = True,
+) -> COO:
+    """Build the kNN graph of dense rows ``x`` (n, d).
+
+    Edges (i → j) for each of i's k nearest neighbors excluding self;
+    ``symmetrize`` mirrors edges (A ∪ Aᵀ, values combined by max) like the
+    reference's symmetrization step before MST
+    (hierarchy/detail/mst.cuh uses coo_symmetrize).
+    """
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    dists, idxs = brute_force_knn(x, x, k + 1, metric=metric)
+    # drop the self column (nearest is self at distance ~0)
+    dists = dists[:, 1:]
+    idxs = idxs[:, 1:]
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    cols = idxs.reshape(-1)
+    vals = dists.reshape(-1)
+    g = COO(rows, cols, vals, jnp.int32(n * k), (n, n))
+    if symmetrize:
+        from raft_tpu.sparse.linalg import coo_symmetrize
+
+        g = coo_symmetrize(g, combine="max")
+    return coo_sort(g)

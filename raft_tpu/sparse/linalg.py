@@ -1,0 +1,178 @@
+"""Sparse linear algebra — analog of raft/sparse/linalg
+(cpp/include/raft/sparse/linalg/: add.cuh, degree.cuh, norm.cuh,
+symmetrize.cuh, transpose.cuh, spectral.cuh) plus the cuSPARSE spmv/spmm
+wrappers (sparse/detail/cusparse_wrappers.h) expressed as segment ops.
+
+TPU notes: segment-sum gathers (``vals * x[cols]`` scattered to rows) are
+the irregular core; XLA lowers them to sort/scatter — acceptable for the
+solver-support role these play. The dense-block SpMM used by sparse
+*distances* lives in :mod:`raft_tpu.sparse.distance` (densified MXU path).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.coo import COO, CSR, coo_from_csr, csr_from_coo
+from raft_tpu.sparse.op import coo_sort, sum_duplicates
+
+__all__ = [
+    "coo_degree",
+    "csr_row_normalize_l1",
+    "csr_row_normalize_max",
+    "rows_norm",
+    "coo_symmetrize",
+    "transpose",
+    "csr_add",
+    "spmv",
+    "spmm",
+    "fit_embedding",
+]
+
+
+def coo_degree(coo: COO) -> jax.Array:
+    """Row degrees (reference sparse/linalg/degree.cuh coo_degree)."""
+    return coo.degree()
+
+
+def _row_scatter(csr: CSR, contrib, reduce: str = "add"):
+    m = csr.shape[0]
+    rows = csr.row_ids()
+    contrib = jnp.where(csr.valid_mask(), contrib, 0)
+    out = jnp.zeros((m,), contrib.dtype)
+    if reduce == "add":
+        return out.at[rows].add(contrib)
+    return out.at[rows].max(contrib)
+
+
+def rows_norm(csr: CSR, norm: str = "l2") -> jax.Array:
+    """Per-row norms (reference sparse/linalg/norm.cuh rowNormCsr)."""
+    if norm == "l1":
+        return _row_scatter(csr, jnp.abs(csr.data))
+    if norm == "l2":
+        return jnp.sqrt(_row_scatter(csr, csr.data * csr.data))
+    if norm == "linf":
+        return _row_scatter(csr, jnp.abs(csr.data), reduce="max")
+    raise ValueError(norm)
+
+
+def csr_row_normalize_l1(csr: CSR) -> CSR:
+    """Scale rows to unit L1 (reference linalg/norm.cuh csr_row_normalize_l1)."""
+    norms = _row_scatter(csr, jnp.abs(csr.data))
+    scale = jnp.where(norms == 0, 1.0, norms)[csr.row_ids()]
+    data = jnp.where(csr.valid_mask(), csr.data / scale, 0)
+    return CSR(csr.indptr, csr.indices, data, csr.nnz, csr.shape)
+
+
+def csr_row_normalize_max(csr: CSR) -> CSR:
+    norms = _row_scatter(csr, jnp.abs(csr.data), reduce="max")
+    scale = jnp.where(norms == 0, 1.0, norms)[csr.row_ids()]
+    data = jnp.where(csr.valid_mask(), csr.data / scale, 0)
+    return CSR(csr.indptr, csr.indices, data, csr.nnz, csr.shape)
+
+
+def transpose(coo: COO) -> COO:
+    """Swap rows/cols and re-sort (reference sparse/linalg/transpose.cuh —
+    there a cusparse csr2csc; here a relabel + sort)."""
+    m, n = coo.shape
+    return coo_sort(COO(coo.cols, coo.rows, coo.vals, coo.nnz, (n, m)))
+
+
+def coo_symmetrize(coo: COO, combine: str = "sum") -> COO:
+    """A + Aᵀ with duplicate combination (reference
+    sparse/linalg/symmetrize.cuh coo_symmetrize — there a custom kernel
+    summing mirrored edges; 'max' gives the kNN-graph symmetrization)."""
+    cap = coo.capacity
+    rows = jnp.concatenate([coo.rows, coo.cols])
+    cols = jnp.concatenate([coo.cols, coo.rows])
+    vals = jnp.concatenate([coo.vals, coo.vals])
+    both = COO(rows, cols, vals, 2 * coo.nnz, coo.shape)
+    # mirrored padding entries must stay invalid: rebuild mask
+    valid = jnp.concatenate([coo.valid_mask(), coo.valid_mask()])
+    both = COO(
+        jnp.where(valid, rows, 0),
+        jnp.where(valid, cols, 0),
+        jnp.where(valid, vals, 0),
+        2 * coo.nnz,
+        coo.shape,
+    )
+    # ordering: all valid first (they already are interleaved — compact)
+    order = jnp.argsort(~valid, stable=True)
+    both = COO(both.rows[order], both.cols[order], both.vals[order],
+               2 * coo.nnz, coo.shape)
+    if combine == "sum":
+        return sum_duplicates(both)
+    from raft_tpu.sparse.op import max_duplicates
+
+    return max_duplicates(both)
+
+
+def csr_add(a: CSR, b: CSR) -> CSR:
+    """C = A + B with structural union (reference sparse/linalg/add.cuh
+    csr_add_calc_inds/csr_add_finalize). Capacity grows to cap_a + cap_b."""
+    assert a.shape == b.shape
+    ca = coo_from_csr(a)
+    cb = coo_from_csr(b)
+    rows = jnp.concatenate([ca.rows, cb.rows])
+    cols = jnp.concatenate([ca.cols, cb.cols])
+    vals = jnp.concatenate([ca.vals, cb.vals])
+    valid = jnp.concatenate([ca.valid_mask(), cb.valid_mask()])
+    order = jnp.argsort(~valid, stable=True)
+    merged = COO(
+        jnp.where(valid, rows, 0)[order],
+        jnp.where(valid, cols, 0)[order],
+        jnp.where(valid, vals, 0)[order],
+        a.nnz + b.nnz,
+        a.shape,
+    )
+    return csr_from_coo(sum_duplicates(merged))
+
+
+def spmv(csr: CSR, x) -> jax.Array:
+    """y = A @ x (reference cusparsespmv wrapper): gather + segment-sum."""
+    x = jnp.asarray(x)
+    contrib = jnp.where(csr.valid_mask(), csr.data * x[csr.indices], 0)
+    return jnp.zeros((csr.shape[0],), contrib.dtype).at[csr.row_ids()].add(contrib)
+
+
+def spmm(csr: CSR, x) -> jax.Array:
+    """Y = A @ X for dense X (n, d) (reference cusparsespmm wrapper)."""
+    x = jnp.asarray(x)
+    gathered = x[csr.indices] * jnp.where(csr.valid_mask(), csr.data, 0)[:, None]
+    return (
+        jnp.zeros((csr.shape[0], x.shape[1]), gathered.dtype)
+        .at[csr.row_ids()]
+        .add(gathered)
+    )
+
+
+def fit_embedding(
+    csr: CSR,
+    n_components: int,
+    *,
+    seed: int = 42,
+    ncv: Optional[int] = None,
+):
+    """Spectral embedding of a (symmetric, nonneg) adjacency CSR — analog of
+    ``raft::sparse::spectral::fit_embedding`` (sparse/linalg/spectral.cuh):
+    smallest eigenvectors of the graph Laplacian L = D - A via Lanczos,
+    dropping the trivial constant component.
+
+    Returns (n, n_components) embedding.
+    """
+    from raft_tpu.linalg.lanczos import lanczos_solver
+
+    n = csr.shape[0]
+    deg = _row_scatter(csr, csr.data)
+
+    def lap_matvec(v):
+        return deg * v - spmv(csr, v)
+
+    k = n_components + 1
+    vals, vecs = lanczos_solver(
+        lap_matvec, n, k, ncv=ncv, seed=seed, smallest=True
+    )
+    return vecs[:, 1 : n_components + 1]

@@ -1,0 +1,152 @@
+"""Sparse structural ops — analog of raft/sparse/op
+(cpp/include/raft/sparse/op/: sort.cuh coo_sort:41, filter.cuh
+coo_remove_scalar:46, reduce.cuh max_duplicates:72, slice.cuh
+csr_row_slice_*:40-65, row_op.cuh csr_row_op:39).
+
+All ops preserve the static capacity; compaction moves dropped entries to
+the padded tail (stable argsort on the drop flag — the TPU substitute for
+stream compaction).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.coo import COO, CSR
+
+__all__ = [
+    "coo_sort",
+    "coo_remove_scalar",
+    "coo_remove_zeros",
+    "max_duplicates",
+    "sum_duplicates",
+    "csr_row_slice",
+    "csr_row_op",
+]
+
+
+def _reorder(coo: COO, order) -> COO:
+    return COO(
+        coo.rows[order], coo.cols[order], coo.vals[order], coo.nnz, coo.shape
+    )
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort by (row, col), padding last (reference op/sort.cuh:41 coo_sort —
+    there a cub radix sort on linearised indices; here two stable argsorts,
+    the TPU-tuned sort primitive)."""
+    cap = coo.capacity
+    valid = coo.valid_mask()
+    # stable lexsort: minor key first, then major
+    order1 = jnp.argsort(coo.cols, stable=True)
+    rows1 = coo.rows[order1]
+    # padding sorts after every valid row
+    rowkey = jnp.where(valid[order1], rows1, coo.shape[0])
+    order2 = jnp.argsort(rowkey, stable=True)
+    return _reorder(coo, order1[order2])
+
+
+def _compact(coo: COO, keep) -> COO:
+    """Stable-partition kept entries to the front; recount nnz."""
+    keep = keep & coo.valid_mask()
+    order = jnp.argsort(~keep, stable=True)
+    out = _reorder(coo, order)
+    nnz = jnp.sum(keep).astype(jnp.int32)
+    mask = jnp.arange(coo.capacity) < nnz
+    return COO(
+        jnp.where(mask, out.rows, 0),
+        jnp.where(mask, out.cols, 0),
+        jnp.where(mask, out.vals, 0),
+        nnz,
+        coo.shape,
+    )
+
+
+def coo_remove_scalar(coo: COO, scalar) -> COO:
+    """Drop entries equal to ``scalar`` (reference op/filter.cuh:46)."""
+    return _compact(coo, coo.vals != scalar)
+
+
+def coo_remove_zeros(coo: COO) -> COO:
+    return coo_remove_scalar(coo, 0)
+
+
+def _dedupe(coo: COO, combine: str) -> COO:
+    """Collapse duplicate (row, col) entries (reference op/reduce.cuh:72
+    max_duplicates): sort, flag group heads, segment-reduce values."""
+    s = coo_sort(coo)
+    cap = s.capacity
+    valid = s.valid_mask()
+    prev_same = (
+        (s.rows == jnp.roll(s.rows, 1))
+        & (s.cols == jnp.roll(s.cols, 1))
+        & (jnp.arange(cap) > 0)
+    )
+    head = valid & ~prev_same
+    seg = jnp.cumsum(head.astype(jnp.int32)) - 1  # group id per entry
+    seg = jnp.where(valid, seg, cap - 1)
+    if combine == "max":
+        lowest = (
+            jnp.finfo(s.vals.dtype).min
+            if jnp.issubdtype(s.vals.dtype, jnp.floating)
+            else jnp.iinfo(s.vals.dtype).min
+        )
+        init = jnp.full((cap,), lowest, s.vals.dtype)
+        combined = init.at[seg].max(jnp.where(valid, s.vals, lowest))
+        combined = jnp.where(combined == lowest, 0, combined)
+    else:
+        combined = jnp.zeros((cap,), s.vals.dtype).at[seg].add(
+            jnp.where(valid, s.vals, 0)
+        )
+    n_groups = jnp.sum(head).astype(jnp.int32)
+    # representative row/col of each group: scatter heads to their seg slot
+    rows = jnp.zeros((cap,), jnp.int32).at[seg].max(jnp.where(head, s.rows, 0))
+    cols = jnp.zeros((cap,), jnp.int32).at[seg].max(jnp.where(head, s.cols, 0))
+    mask = jnp.arange(cap) < n_groups
+    return COO(
+        jnp.where(mask, rows, 0),
+        jnp.where(mask, cols, 0),
+        jnp.where(mask, combined, 0),
+        n_groups,
+        coo.shape,
+    )
+
+
+def max_duplicates(coo: COO) -> COO:
+    """Keep the max value among duplicates (reference op/reduce.cuh:72)."""
+    return _dedupe(coo, "max")
+
+
+def sum_duplicates(coo: COO) -> COO:
+    """Sum duplicates (canonicalisation used by symmetrize/add)."""
+    return _dedupe(coo, "sum")
+
+
+def csr_row_slice(csr: CSR, start: int, stop: int) -> CSR:
+    """Extract rows [start, stop) (reference op/slice.cuh:40-65
+    csr_row_slice_indptr + csr_row_slice_populate). Capacity is preserved;
+    entries outside the slice are compacted to the tail."""
+    lo = csr.indptr[start]
+    hi = csr.indptr[stop]
+    cap = csr.capacity
+    pos = jnp.arange(cap)
+    keep = (pos >= lo) & (pos < hi)
+    order = jnp.argsort(~keep, stable=True)
+    nnz = (hi - lo).astype(jnp.int32)
+    mask = pos < nnz
+    indices = jnp.where(mask, csr.indices[order], 0)
+    data = jnp.where(mask, csr.data[order], 0)
+    indptr = (csr.indptr[start : stop + 1] - lo).astype(jnp.int32)
+    return CSR(indptr, indices, data, nnz, (stop - start, csr.shape[1]))
+
+
+def csr_row_op(csr: CSR, fn: Callable) -> CSR:
+    """Apply ``fn(row_id, data) -> data`` across entries (reference
+    op/row_op.cuh:39 csr_row_op — the per-row lambda kernel)."""
+    rows = csr.row_ids()
+    new_data = fn(rows, csr.data)
+    new_data = jnp.where(csr.valid_mask(), new_data, 0)
+    return CSR(csr.indptr, csr.indices, new_data, csr.nnz, csr.shape)

@@ -1,0 +1,210 @@
+"""Sparse suite tests — scipy-free numpy oracles (reference
+cpp/test/sparse/{sort,filter,convert_coo,convert_csr,norm,symmetrize,
+add,dist_coo_spmv,knn,knn_graph}.cu patterns)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse import (
+    COO,
+    coo_from_dense,
+    csr_from_coo,
+    coo_from_csr,
+    op,
+    linalg as slinalg,
+    sparse_pairwise_distance,
+    sparse_brute_force_knn,
+    knn_graph,
+)
+
+
+def random_sparse(rng, m, n, density=0.2, cap_extra=5):
+    dense = rng.random((m, n)).astype(np.float32)
+    dense[dense > density] = 0.0
+    return dense, coo_from_dense(dense, capacity=int((dense != 0).sum()) + cap_extra)
+
+
+def test_coo_roundtrip(rng_np):
+    dense, coo = random_sparse(rng_np, 10, 8)
+    np.testing.assert_allclose(np.asarray(coo.to_dense()), dense)
+    csr = csr_from_coo(coo)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+    back = coo_from_csr(csr)
+    np.testing.assert_allclose(np.asarray(back.to_dense()), dense)
+
+
+def test_coo_sort(rng_np):
+    dense, coo = random_sparse(rng_np, 12, 9)
+    # shuffle the VALID entries then sort (padding must stay at the tail —
+    # the container invariant)
+    nnz0 = int(coo.nnz)
+    perm = np.concatenate(
+        [rng_np.permutation(nnz0), np.arange(nnz0, coo.capacity)]
+    )
+    shuffled = COO(coo.rows[perm], coo.cols[perm], coo.vals[perm], coo.nnz, coo.shape)
+    s = op.coo_sort(shuffled)
+    nnz = int(s.nnz)
+    r = np.asarray(s.rows)[:nnz]
+    c = np.asarray(s.cols)[:nnz]
+    keys = r.astype(np.int64) * s.shape[1] + c
+    assert (np.diff(keys) >= 0).all()
+    np.testing.assert_allclose(np.asarray(s.to_dense()), dense)
+
+
+def test_coo_remove_scalar(rng_np):
+    dense = np.array([[1, 0, 2], [2, 2, 0], [0, 3, 1]], np.float32)
+    coo = coo_from_dense(dense, capacity=8)
+    out = op.coo_remove_scalar(coo, 2.0)
+    want = dense.copy()
+    want[want == 2] = 0
+    np.testing.assert_allclose(np.asarray(out.to_dense()), want)
+    assert int(out.nnz) == (want != 0).sum()
+
+
+def test_max_duplicates():
+    rows = jnp.array([0, 0, 1, 1, 1, 0], jnp.int32)
+    cols = jnp.array([1, 1, 2, 2, 3, 0], jnp.int32)
+    vals = jnp.array([3.0, 5.0, 1.0, 7.0, 2.0, 4.0], jnp.float32)
+    coo = COO(rows, cols, vals, jnp.int32(6), (2, 4))
+    out = op.max_duplicates(coo)
+    dense = np.asarray(out.to_dense())
+    want = np.zeros((2, 4), np.float32)
+    want[0, 1] = 5.0
+    want[1, 2] = 7.0
+    want[1, 3] = 2.0
+    want[0, 0] = 4.0
+    np.testing.assert_allclose(dense, want)
+    assert int(out.nnz) == 4
+
+
+def test_csr_row_slice(rng_np):
+    dense, coo = random_sparse(rng_np, 10, 6)
+    csr = csr_from_coo(coo)
+    sl = op.csr_row_slice(csr, 3, 8)
+    np.testing.assert_allclose(np.asarray(sl.to_dense()), dense[3:8])
+
+
+def test_csr_row_op(rng_np):
+    dense, coo = random_sparse(rng_np, 6, 5)
+    csr = csr_from_coo(coo)
+    out = op.csr_row_op(csr, lambda rows, data: data * (rows + 1))
+    want = dense * (np.arange(6)[:, None] + 1)
+    np.testing.assert_allclose(np.asarray(out.to_dense()), want, rtol=1e-6)
+
+
+def test_degree_norms(rng_np):
+    dense, coo = random_sparse(rng_np, 8, 7)
+    np.testing.assert_array_equal(
+        np.asarray(slinalg.coo_degree(coo)), (dense != 0).sum(1)
+    )
+    csr = csr_from_coo(coo)
+    np.testing.assert_allclose(
+        np.asarray(slinalg.rows_norm(csr, "l1")), np.abs(dense).sum(1), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(slinalg.rows_norm(csr, "l2")),
+        np.sqrt((dense**2).sum(1)),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(slinalg.rows_norm(csr, "linf")), np.abs(dense).max(1), rtol=1e-5
+    )
+
+
+def test_row_normalize(rng_np):
+    dense, coo = random_sparse(rng_np, 8, 7)
+    csr = csr_from_coo(coo)
+    out = np.asarray(slinalg.csr_row_normalize_l1(csr).to_dense())
+    sums = np.abs(dense).sum(1, keepdims=True)
+    want = np.where(sums > 0, dense / np.where(sums == 0, 1, sums), 0)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_transpose(rng_np):
+    dense, coo = random_sparse(rng_np, 9, 5)
+    t = slinalg.transpose(coo)
+    np.testing.assert_allclose(np.asarray(t.to_dense()), dense.T)
+
+
+def test_symmetrize(rng_np):
+    dense, coo = random_sparse(rng_np, 7, 7)
+    s = slinalg.coo_symmetrize(coo, combine="sum")
+    np.testing.assert_allclose(
+        np.asarray(s.to_dense()), dense + dense.T, rtol=1e-5
+    )
+    smax = slinalg.coo_symmetrize(coo, combine="max")
+    np.testing.assert_allclose(
+        np.asarray(smax.to_dense()), np.maximum(dense, dense.T), rtol=1e-5
+    )
+
+
+def test_csr_add(rng_np):
+    da, ca = random_sparse(rng_np, 6, 6)
+    db, cb = random_sparse(rng_np, 6, 6)
+    out = slinalg.csr_add(csr_from_coo(ca), csr_from_coo(cb))
+    np.testing.assert_allclose(np.asarray(out.to_dense()), da + db, rtol=1e-5)
+
+
+def test_spmv_spmm(rng_np):
+    dense, coo = random_sparse(rng_np, 10, 8)
+    csr = csr_from_coo(coo)
+    x = rng_np.standard_normal(8).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(slinalg.spmv(csr, x)), dense @ x, rtol=1e-4, atol=1e-5
+    )
+    X = rng_np.standard_normal((8, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(slinalg.spmm(csr, X)), dense @ X, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_sparse_pairwise_distance(rng_np):
+    da, ca = random_sparse(rng_np, 15, 12, density=0.4)
+    db, cb = random_sparse(rng_np, 11, 12, density=0.4)
+    got = np.asarray(
+        sparse_pairwise_distance(
+            csr_from_coo(ca), csr_from_coo(cb), "sqeuclidean", block_m=4
+        )
+    )
+    want = ((da[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_knn_matches_dense(rng_np):
+    da, ca = random_sparse(rng_np, 40, 10, density=0.5)
+    db, cb = random_sparse(rng_np, 25, 10, density=0.5)
+    d, i = sparse_brute_force_knn(
+        csr_from_coo(ca), csr_from_coo(cb), 5,
+        metric="sqeuclidean", block_q=8, block_n=16,
+    )
+    full = ((db[:, None, :] - da[None, :, :]) ** 2).sum(-1)
+    want_i = np.argsort(full, 1)[:, :5]
+    want_d = np.take_along_axis(full, want_i, 1)
+    np.testing.assert_allclose(np.asarray(d), want_d, rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(i), want_i)
+
+
+def test_knn_graph(rng_np):
+    x = rng_np.standard_normal((30, 4)).astype(np.float32)
+    g = knn_graph(x, 3)
+    dense = np.asarray(g.to_dense())
+    # symmetric, zero diagonal, each row has >= 3 edges
+    np.testing.assert_allclose(dense, dense.T, rtol=1e-5)
+    assert (np.diag(dense) == 0).all()
+    assert ((dense > 0).sum(1) >= 3).all()
+
+
+def test_fit_embedding_separates_components(rng_np):
+    # two disconnected cliques: the Fiedler-style embedding separates them
+    n = 12
+    dense = np.zeros((n, n), np.float32)
+    for grp in (range(6), range(6, 12)):
+        for i in grp:
+            for j in grp:
+                if i != j:
+                    dense[i, j] = 1.0
+    csr = csr_from_coo(coo_from_dense(dense))
+    emb = np.asarray(slinalg.fit_embedding(csr, 2, seed=0))
+    assert emb.shape == (12, 2)
