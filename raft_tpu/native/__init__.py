@@ -1,0 +1,111 @@
+"""Native host runtime — ctypes bindings over the C++ host algorithms
+(src/host_algos.cpp), the analog of the reference's precompiled runtime
+libraries (libraft_distance/libraft_nn, cpp/src/, SURVEY.md §2 #42-43):
+non-templated native entry points the Python layer calls directly.
+
+The shared library is compiled lazily with g++ on first import and cached
+next to the package; importing this module raises ImportError when no
+binary can be produced, and callers fall back to numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "host_algos.cpp")
+_LIB = os.path.join(_HERE, "libraft_tpu_host.so")
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", _LIB, _SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load() -> ctypes.CDLL:
+    if (not os.path.exists(_LIB)) or (
+        os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    ):
+        _build()
+    return ctypes.CDLL(_LIB)
+
+
+try:
+    _lib = _load()
+except Exception as e:  # no toolchain / build failure -> numpy fallbacks
+    raise ImportError(f"raft_tpu.native unavailable: {e}") from e
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+_lib.rt_build_dendrogram.restype = ctypes.c_int64
+_lib.rt_build_dendrogram.argtypes = [
+    _i32p, _i32p, _f32p, ctypes.c_int64, ctypes.c_int32, _i64p, _f64p, _i64p,
+]
+_lib.rt_extract_flat.restype = None
+_lib.rt_extract_flat.argtypes = [
+    _i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, _i32p,
+]
+_lib.rt_make_monotonic.restype = ctypes.c_int32
+_lib.rt_make_monotonic.argtypes = [_i32p, _i32p, ctypes.c_int64, ctypes.c_int32]
+_lib.rt_merge_topk.restype = None
+_lib.rt_merge_topk.argtypes = [
+    _f32p, _i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, _f32p, _i32p,
+]
+
+
+def dendrogram(src, dst, weights, n: int):
+    """Agglomerative merge of weight-sorted edges (native
+    build_dendrogram_host). Returns (children (n_merges, 2), deltas, sizes)."""
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    weights = np.ascontiguousarray(weights, np.float32)
+    children = np.zeros((max(n - 1, 1), 2), np.int64)
+    deltas = np.zeros(max(n - 1, 1), np.float64)
+    sizes = np.zeros(max(n - 1, 1), np.int64)
+    n_merges = _lib.rt_build_dendrogram(
+        src, dst, weights, len(src), n, children.reshape(-1), deltas, sizes
+    )
+    return children[:n_merges], deltas[:n_merges], sizes[:n_merges]
+
+
+def extract_flat(children, n: int, n_clusters: int) -> np.ndarray:
+    """Native dendrogram cut + monotonic relabel."""
+    children = np.ascontiguousarray(children, np.int64)
+    labels = np.zeros(n, np.int32)
+    _lib.rt_extract_flat(
+        children.reshape(-1), len(children), n, n_clusters, labels
+    )
+    return labels
+
+
+def make_monotonic(labels, n_max: int = None) -> np.ndarray:
+    """Native first-occurrence monotonic relabel (label/classlabels.cuh)."""
+    labels = np.ascontiguousarray(labels, np.int32)
+    if n_max is None:
+        n_max = int(labels.max()) + 1 if len(labels) else 1
+    out = np.zeros_like(labels)
+    _lib.rt_make_monotonic(labels, out, len(labels), n_max)
+    return out
+
+
+def merge_topk(part_dists, part_indices):
+    """Native P-way sorted merge of (P, m, k) top-k lists."""
+    d = np.ascontiguousarray(part_dists, np.float32)
+    i = np.ascontiguousarray(part_indices, np.int32)
+    P, m, k = d.shape
+    out_d = np.zeros((m, k), np.float32)
+    out_i = np.zeros((m, k), np.int32)
+    _lib.rt_merge_topk(d.reshape(-1), i.reshape(-1), P, m, k,
+                       out_d.reshape(-1), out_i.reshape(-1))
+    return out_d, out_i

@@ -1,0 +1,77 @@
+"""Connected-components fixup — analog of
+``raft::linkage::connect_components``
+(cpp/include/raft/sparse/selection/connect_components.cuh:66, custom reduce
+op ``FixConnectivitiesRedOp`` detail/connect_components.cuh:95-134).
+
+Given points and a component coloring (e.g. from an MSF over an incomplete
+kNN graph), find for every component its nearest point in a *different*
+component — a masked fused L2 1-NN (the ``mask_op`` hook of
+:func:`fused_l2_nn` is exactly the reference's same-color-masking reduce
+op) — and emit the cross-component edges that stitch the graph together.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+from raft_tpu.sparse.coo import COO
+
+__all__ = ["connect_components", "get_n_components"]
+
+
+def get_n_components(color) -> jax.Array:
+    """Number of distinct colors (reference get_n_components)."""
+    color = jnp.asarray(color)
+    n = color.shape[0]
+    present = jnp.zeros((n,), jnp.int32).at[color].max(1)
+    return jnp.sum(present)
+
+
+def connect_components(x, color) -> COO:
+    """Return a COO of cross-component nearest-neighbor edges
+    (one best edge per source component, symmetrized by the caller's
+    downstream dedupe): for each component c, the globally closest pair
+    (i ∈ c, j ∉ c).
+
+    Reference flow (connect_components.cuh:66): fusedL2NN with a reduce op
+    that ignores same-color candidates, then a segment-min per color.
+    """
+    x = jnp.asarray(x)
+    color = jnp.asarray(color)
+    n = x.shape[0]
+
+    def mask_op(rows, cols):
+        return color[rows] != color[cols]
+
+    minv, mini = fused_l2_nn(x, x, mask_op=mask_op)
+
+    # segment-min per color: best cross edge of each component
+    best = jnp.full((n,), jnp.inf).at[color].min(minv)
+    is_best = (minv == best[color])
+    # tie-break to one representative per color: min row index among ties
+    big = jnp.int32(n)
+    rep = (
+        jnp.full((n,), big, jnp.int32)
+        .at[color]
+        .min(jnp.where(is_best, jnp.arange(n, dtype=jnp.int32), big))
+    )
+    chosen = rep[color] == jnp.arange(n)  # row i is its component's pick
+    rows = jnp.where(chosen, jnp.arange(n, dtype=jnp.int32), 0)
+    cols = jnp.where(chosen, mini, 0)
+    vals = jnp.where(chosen, minv, 0.0)
+
+    # compact chosen edges to the front
+    order = jnp.argsort(~chosen, stable=True)
+    nnz = jnp.sum(chosen).astype(jnp.int32)
+    mask = jnp.arange(n) < nnz
+    return COO(
+        jnp.where(mask, rows[order], 0),
+        jnp.where(mask, cols[order], 0),
+        jnp.where(mask, vals[order], 0.0),
+        nnz,
+        (n, n),
+    )

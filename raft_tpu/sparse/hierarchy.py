@@ -1,0 +1,194 @@
+"""Single-linkage hierarchical clustering — analog of
+``raft::hierarchy::single_linkage``
+(cpp/include/raft/sparse/hierarchy/detail/single_linkage.cuh:54-119:
+get_distance_graph → build_sorted_mst (+ connect_components fixup,
+detail/mst.cuh) → build_dendrogram_host (detail/agglomerative.cuh, a HOST
+union-find merge — same boundary here) → extract_flattened_clusters).
+
+The device side (kNN graph, MST, cross-component stitching) is all JAX; the
+agglomerative dendrogram walk is inherently sequential and tiny (n-1 merges
+over sorted edges), so it runs on host — through the native C++ extension
+when built (raft_tpu.native), else numpy union-find.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.coo import COO
+from raft_tpu.sparse.knn_graph import knn_graph
+from raft_tpu.sparse.mst import boruvka_mst
+from raft_tpu.sparse.connect import connect_components, get_n_components
+from raft_tpu.sparse.op import coo_sort, sum_duplicates
+
+__all__ = [
+    "LinkageResult",
+    "build_sorted_mst",
+    "build_dendrogram_host",
+    "extract_flattened_clusters",
+    "single_linkage",
+]
+
+
+class LinkageResult(NamedTuple):
+    """Analog of raft::hierarchy::linkage_output (hierarchy/common.h)."""
+
+    labels: jax.Array      # (n,) int32 flat cluster labels
+    children: np.ndarray   # (n-1, 2) merge tree (scipy convention)
+    deltas: np.ndarray     # (n-1,) merge distances
+    sizes: np.ndarray      # (n-1,) merged cluster sizes
+    n_clusters: int
+
+
+def build_sorted_mst(x, graph: COO, *, max_iter: int = 32):
+    """MST with connect-components fixup loop (reference
+    hierarchy/detail/mst.cuh build_sorted_mst: solve, and while the forest
+    is disconnected, connect_components + re-solve). Returns
+    (src, dst, weight) numpy arrays sorted by weight, length n-1."""
+    n = graph.shape[0]
+    mst = boruvka_mst(graph)
+    it = 0
+    while int(get_n_components(mst.color)) > 1 and it < max_iter:
+        extra = connect_components(x, mst.color)
+        # merge extra edges into the graph (symmetrize via mirrored concat)
+        rows = jnp.concatenate([graph.rows, extra.rows, extra.cols])
+        cols = jnp.concatenate([graph.cols, extra.cols, extra.rows])
+        vals = jnp.concatenate([graph.vals, extra.vals, extra.vals])
+        valid = jnp.concatenate(
+            [graph.valid_mask(), extra.valid_mask(), extra.valid_mask()]
+        )
+        order = jnp.argsort(~valid, stable=True)
+        graph = COO(
+            jnp.where(valid, rows, 0)[order],
+            jnp.where(valid, cols, 0)[order],
+            jnp.where(valid, vals, 0)[order],
+            graph.nnz + 2 * extra.nnz,
+            graph.shape,
+        )
+        graph = sum_duplicates(graph)  # dedupe repeated edges (keep sum==val)
+        mst = boruvka_mst(graph)
+        it += 1
+
+    k = int(mst.n_edges)
+    src = np.asarray(mst.src)[:k]
+    dst = np.asarray(mst.dst)[:k]
+    w = np.asarray(mst.weight)[:k]
+    order = np.argsort(w, kind="stable")
+    return src[order], dst[order], w[order]
+
+
+def build_dendrogram_host(src, dst, weights, n: int):
+    """Agglomerative merge of weight-sorted MST edges on host
+    (reference detail/agglomerative.cuh build_dendrogram_host — the
+    device→host boundary is the same). Returns (children (n-1, 2), deltas,
+    sizes) in the scipy convention: new cluster i gets id n + i."""
+    try:
+        from raft_tpu.native import dendrogram as _native_dendro
+    except ImportError:
+        _native_dendro = None
+    if _native_dendro is not None:
+        return _native_dendro(
+            np.ascontiguousarray(src, np.int32),
+            np.ascontiguousarray(dst, np.int32),
+            np.ascontiguousarray(weights, np.float32),
+            n,
+        )
+
+    parent = np.arange(2 * n - 1, dtype=np.int64)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    children = np.zeros((n - 1, 2), np.int64)
+    deltas = np.zeros(n - 1, np.float64)
+    sizes = np.zeros(n - 1, np.int64)
+    cluster_size = np.ones(2 * n - 1, np.int64)
+    nxt = n
+    for e in range(len(src)):
+        a = find(src[e])
+        b = find(dst[e])
+        if a == b:
+            continue
+        children[nxt - n] = (a, b)
+        deltas[nxt - n] = weights[e]
+        cluster_size[nxt] = cluster_size[a] + cluster_size[b]
+        sizes[nxt - n] = cluster_size[nxt]
+        parent[a] = nxt
+        parent[b] = nxt
+        nxt += 1
+    return children[: nxt - n], deltas[: nxt - n], sizes[: nxt - n]
+
+
+def extract_flattened_clusters(children, n: int, n_clusters: int) -> np.ndarray:
+    """Cut the dendrogram into ``n_clusters`` flat labels (reference
+    detail/agglomerative.cuh extract_flattened_clusters): undo the last
+    (n_clusters - 1) merges, label the remaining forests, relabel
+    monotonically by first occurrence."""
+    try:
+        from raft_tpu.native import extract_flat as _native_flat
+    except ImportError:
+        _native_flat = None
+    if _native_flat is not None:
+        return _native_flat(np.ascontiguousarray(children, np.int64), n, n_clusters)
+
+    n_merges = len(children) - (n_clusters - 1)
+    parent = np.arange(2 * n - 1, dtype=np.int64)
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for e in range(max(n_merges, 0)):
+        a, b = children[e]
+        parent[find(a)] = find(n + e)
+        parent[find(b)] = find(n + e)
+    roots = np.array([find(i) for i in range(n)])
+    # monotonic relabel (reference label/classlabels.cuh make_monotonic)
+    _, labels = np.unique(roots, return_inverse=True)
+    order = np.zeros(labels.max() + 1, np.int64) - 1
+    out = np.zeros(n, np.int32)
+    nxt = 0
+    for i in range(n):
+        if order[labels[i]] < 0:
+            order[labels[i]] = nxt
+            nxt += 1
+        out[i] = order[labels[i]]
+    return out
+
+
+def single_linkage(
+    x,
+    n_clusters: int = 2,
+    *,
+    graph: Optional[COO] = None,
+    k: int = 16,
+    metric="l2_sqrt_expanded",
+) -> LinkageResult:
+    """Full pipeline (reference single_linkage.cuh:54): kNN distance graph →
+    sorted MST (+stitching) → host dendrogram → flat labels.
+
+    ``graph`` overrides the kNN graph (the reference's pairwise/"auto"
+    distance-graph choice, LinkageDistance enum)."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    if graph is None:
+        graph = knn_graph(x, min(k, n - 1), metric=metric)
+    src, dst, w = build_sorted_mst(x, graph)
+    children, deltas, sizes = build_dendrogram_host(src, dst, w, n)
+    labels = extract_flattened_clusters(children, n, n_clusters)
+    return LinkageResult(
+        jnp.asarray(labels), np.asarray(children), np.asarray(deltas),
+        np.asarray(sizes), n_clusters,
+    )

@@ -1,0 +1,152 @@
+"""MST / connect_components / single-linkage tests — golden-fixture +
+invariant patterns (reference cpp/test/mst.cu, cpp/test/sparse/
+connect_components.cu, cpp/test/sparse/linkage.cu)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse import COO, coo_from_dense
+from raft_tpu.sparse.mst import boruvka_mst
+from raft_tpu.sparse.connect import connect_components, get_n_components
+from raft_tpu.sparse.hierarchy import (
+    build_sorted_mst,
+    build_dendrogram_host,
+    extract_flattened_clusters,
+    single_linkage,
+)
+from raft_tpu.sparse.knn_graph import knn_graph
+
+
+def naive_mst_weight(dense):
+    """Prim's algorithm on a dense adjacency (0 = no edge)."""
+    n = dense.shape[0]
+    adj = np.where(dense > 0, dense, np.inf)
+    visited = np.zeros(n, bool)
+    visited[0] = True
+    total = 0.0
+    for _ in range(n - 1):
+        best = np.inf
+        bi = bj = -1
+        for i in range(n):
+            if visited[i]:
+                for j in range(n):
+                    if not visited[j] and adj[i, j] < best:
+                        best, bi, bj = adj[i, j], i, j
+        if bj < 0:
+            break
+        visited[bj] = True
+        total += best
+    return total, visited.sum()
+
+
+def random_graph(rng, n, p=0.4):
+    dense = rng.random((n, n)).astype(np.float32)
+    dense = np.where(rng.random((n, n)) < p, dense, 0)
+    dense = np.triu(dense, 1)
+    dense = dense + dense.T
+    return dense
+
+
+def test_mst_matches_prim(rng_np):
+    for trial in range(3):
+        dense = random_graph(rng_np, 20)
+        want_w, n_reach = naive_mst_weight(dense)
+        if n_reach < 20:
+            continue
+        mst = boruvka_mst(coo_from_dense(dense))
+        k = int(mst.n_edges)
+        assert k == 19
+        got_w = float(np.asarray(mst.weight)[:k].sum())
+        np.testing.assert_allclose(got_w, want_w, rtol=1e-5)
+        # connected: one color
+        assert int(get_n_components(mst.color)) == 1
+
+
+def test_mst_forest_on_disconnected():
+    # two triangles, no bridge
+    dense = np.zeros((6, 6), np.float32)
+    for a, b, w in [(0, 1, 1), (1, 2, 2), (0, 2, 3), (3, 4, 1), (4, 5, 2), (3, 5, 3)]:
+        dense[a, b] = dense[b, a] = w
+    mst = boruvka_mst(coo_from_dense(dense))
+    assert int(mst.n_edges) == 4  # 2 edges per triangle
+    assert int(get_n_components(mst.color)) == 2
+    np.testing.assert_allclose(
+        sorted(np.asarray(mst.weight)[:4]), [1, 1, 2, 2]
+    )
+
+
+def test_mst_tie_breaking_deterministic():
+    # all weights equal: still a valid spanning tree
+    dense = np.ones((8, 8), np.float32) - np.eye(8, dtype=np.float32)
+    mst = boruvka_mst(coo_from_dense(dense))
+    assert int(mst.n_edges) == 7
+    assert int(get_n_components(mst.color)) == 1
+
+
+def test_connect_components(rng_np):
+    # two distant blobs with colors from blob id
+    a = rng_np.standard_normal((10, 3)).astype(np.float32)
+    b = rng_np.standard_normal((10, 3)).astype(np.float32) + 50
+    x = np.concatenate([a, b])
+    color = np.repeat([0, 1], 10).astype(np.int32)
+    extra = connect_components(x, color)
+    nnz = int(extra.nnz)
+    assert nnz == 2  # one best edge per component
+    rows = np.asarray(extra.rows)[:nnz]
+    cols = np.asarray(extra.cols)[:nnz]
+    # edges cross the components
+    assert all(color[r] != color[c] for r, c in zip(rows, cols))
+    # and pick the globally closest cross pair
+    d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+    want = d2.min()
+    vals = np.asarray(extra.vals)[:nnz]
+    np.testing.assert_allclose(vals.min(), want, rtol=1e-4)
+
+
+def test_build_sorted_mst_stitches(rng_np):
+    # kNN graph of two far blobs is disconnected; build_sorted_mst must
+    # return a full spanning tree anyway (reference detail/mst.cuh fixup)
+    a = rng_np.standard_normal((15, 4)).astype(np.float32)
+    b = rng_np.standard_normal((15, 4)).astype(np.float32) + 30
+    x = np.concatenate([a, b])
+    g = knn_graph(x, 3)
+    src, dst, w = build_sorted_mst(x, g)
+    assert len(src) == 29
+    assert (np.diff(w) >= 0).all()
+
+
+def test_dendrogram_and_flatten():
+    # golden chain: 4 points on a line at 0, 1, 3, 7
+    x = np.array([[0.0], [1.0], [3.0], [7.0]], np.float32)
+    res = single_linkage(x, n_clusters=2, k=3)
+    labels = np.asarray(res.labels)
+    # the 2-cluster cut splits at the largest merge (distance 4)
+    assert labels[0] == labels[1] == labels[2]
+    assert labels[3] != labels[0]
+    np.testing.assert_allclose(sorted(res.deltas), [1.0, 2.0, 4.0], rtol=1e-5)
+
+
+def test_single_linkage_blobs(rng_np):
+    from raft_tpu.random import make_blobs, RngState
+
+    X, y = make_blobs(200, 5, n_clusters=3, cluster_std=0.3,
+                      state=RngState(11), center_box=(-10.0, 10.0))
+    X = np.asarray(X)
+    y = np.asarray(y)
+    res = single_linkage(X, n_clusters=3, k=8)
+    labels = np.asarray(res.labels)
+    assert len(np.unique(labels)) == 3
+    purity = sum(
+        np.bincount(y[labels == c]).max() for c in np.unique(labels)
+    ) / len(y)
+    assert purity > 0.95
+
+
+def test_extract_flattened_monotonic():
+    children = np.array([[0, 1], [2, 3], [4, 5]])  # n=4: merges -> 4,5,6
+    labels = extract_flattened_clusters(children, 4, 2)
+    # first-occurrence monotonic labels
+    assert labels[0] == 0
+    assert labels.max() == 1

@@ -1,0 +1,65 @@
+"""Native C++ host-algos tests: parity with the numpy fallbacks
+(the reference's pattern of testing runtime-lib entry points against the
+header implementations)."""
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("raft_tpu.native")
+
+
+def test_dendrogram_matches_numpy(rng_np):
+    from raft_tpu.sparse import hierarchy as h
+
+    n = 30
+    # random spanning tree edges, weight-sorted
+    src = np.arange(1, n, dtype=np.int32)
+    dst = np.array([rng_np.integers(0, i) for i in range(1, n)], np.int32)
+    w = np.sort(rng_np.random(n - 1).astype(np.float32))
+
+    got = native.dendrogram(src, dst, w, n)
+
+    # numpy reference: force the fallback path
+    import unittest.mock as mock
+
+    with mock.patch.dict("sys.modules", {"raft_tpu.native": None}):
+        want = h.build_dendrogram_host(src, dst, w, n)
+
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_allclose(got[1], want[1], rtol=1e-6)
+    np.testing.assert_array_equal(got[2], want[2])
+
+
+def test_extract_flat_matches(rng_np):
+    from raft_tpu.sparse.hierarchy import extract_flattened_clusters
+
+    n = 20
+    src = np.arange(1, n, dtype=np.int32)
+    dst = np.array([rng_np.integers(0, i) for i in range(1, n)], np.int32)
+    w = np.sort(rng_np.random(n - 1).astype(np.float32))
+    children, _, _ = native.dendrogram(src, dst, w, n)
+    import unittest.mock as mock
+
+    for k in (2, 3, 5):
+        got = native.extract_flat(children, n, k)
+        with mock.patch.dict("sys.modules", {"raft_tpu.native": None}):
+            want = extract_flattened_clusters(children, n, k)
+        np.testing.assert_array_equal(got, want)
+        assert len(np.unique(got)) == k
+
+
+def test_make_monotonic():
+    labels = np.array([7, 3, 7, 9, 3, 0], np.int32)
+    out = native.make_monotonic(labels)
+    np.testing.assert_array_equal(out, [0, 1, 0, 2, 1, 3])
+
+
+def test_merge_topk(rng_np):
+    P, m, k = 3, 5, 4
+    d = np.sort(rng_np.random((P, m, k)).astype(np.float32), axis=2)
+    i = rng_np.integers(0, 1000, (P, m, k)).astype(np.int32)
+    out_d, out_i = native.merge_topk(d, i)
+    flat = d.transpose(1, 0, 2).reshape(m, P * k)
+    want = np.sort(flat, axis=1)[:, :k]
+    np.testing.assert_allclose(out_d, want, rtol=1e-6)
+    assert (np.diff(out_d, axis=1) >= 0).all()
