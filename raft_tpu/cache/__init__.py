@@ -1,0 +1,7 @@
+"""Vector cache — analog of raft/cache
+(cpp/include/raft/cache/cache_util.cuh:45-334).
+"""
+
+from raft_tpu.cache.cache import VectorCache
+
+__all__ = ["VectorCache"]

@@ -1,0 +1,92 @@
+"""Set-associative vector cache — analog of
+cpp/include/raft/cache/cache_util.cuh:45-334 (``get_vecs``, ``store_vecs``,
+``assign_cache_idx``, ``rank_set_entries``): an LRU-ish cache of feature
+vectors keyed by integer id, used to avoid recomputing expensive per-vector
+work (the reference's use case is SVM kernel columns).
+
+Functional JAX state: (keys, time, store) arrays updated out-of-place; the
+class wraps them with an imperative facade like the reference's
+``cache::Cache``. Lookup and placement are dense gathers/scatters over the
+associativity dimension — no host branching.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["VectorCache"]
+
+
+class VectorCache:
+    """n_sets × associativity cache of (dim,) vectors.
+
+    Keys map to set ``key % n_sets``; victims are chosen by least-recent
+    use within the set (reference rank_set_entries ranks by time).
+    """
+
+    def __init__(self, dim: int, n_sets: int = 256, associativity: int = 8,
+                 dtype=jnp.float32):
+        self.dim = dim
+        self.n_sets = n_sets
+        self.assoc = associativity
+        self.keys = jnp.full((n_sets, associativity), -1, jnp.int32)
+        self.time = jnp.zeros((n_sets, associativity), jnp.int32)
+        self.store = jnp.zeros((n_sets, associativity, dim), dtype)
+        self.clock = 0
+
+    @property
+    def n_cached(self) -> int:
+        return int(jnp.sum(self.keys >= 0))
+
+    def get_vecs(self, query_keys) -> Tuple[jax.Array, jax.Array]:
+        """Fetch vectors for ``query_keys``; returns (vecs (q, dim), found
+        (q,) bool) (reference get_vecs: gathers hits, reports misses)."""
+        q = jnp.asarray(query_keys, jnp.int32)
+        sets = q % self.n_sets
+        lane_keys = self.keys[sets]                      # (q, assoc)
+        hit = lane_keys == q[:, None]
+        found = jnp.any(hit, axis=1)
+        lane = jnp.argmax(hit, axis=1)
+        vecs = self.store[sets, lane]
+        vecs = jnp.where(found[:, None], vecs, 0)
+        # touch hit entries (LRU time update)
+        self.clock += 1
+        self.time = self.time.at[sets, lane].set(
+            jnp.where(found, self.clock, self.time[sets, lane])
+        )
+        return vecs, found
+
+    def store_vecs(self, store_keys, vecs) -> None:
+        """Insert vectors, evicting the LRU entry of each target set
+        (reference store_vecs + assign_cache_idx). Duplicate keys within
+        one call collapse to a single slot (last write wins per scatter
+        semantics)."""
+        k = jnp.asarray(store_keys, jnp.int32)
+        v = jnp.asarray(vecs)
+        sets = k % self.n_sets
+        lane_keys = self.keys[sets]
+        hit = lane_keys == k[:, None]
+        found = jnp.any(hit, axis=1)
+        hit_lane = jnp.argmax(hit, axis=1)
+        # victim: least-recently-used lane of the set (empty lanes have
+        # time 0 and lose ties -> filled first)
+        victim = jnp.argmin(self.time[sets], axis=1)
+        lane = jnp.where(found, hit_lane, victim)
+        self.clock += 1
+        self.keys = self.keys.at[sets, lane].set(k)
+        self.time = self.time.at[sets, lane].set(self.clock)
+        self.store = self.store.at[sets, lane].set(v)
+
+    def evict(self, keys) -> None:
+        """Invalidate entries (no direct reference analog; utility)."""
+        k = jnp.asarray(keys, jnp.int32)
+        sets = k % self.n_sets
+        hit = self.keys[sets] == k[:, None]
+        lane = jnp.argmax(hit, axis=1)
+        found = jnp.any(hit, axis=1)
+        self.keys = self.keys.at[sets, lane].set(
+            jnp.where(found, -1, self.keys[sets, lane])
+        )

@@ -1,0 +1,18 @@
+"""Label utilities — analog of raft/label
+(cpp/include/raft/label/classlabels.cuh:65-114 getUniquelabels /
+make_monotonic / getOvrlabels; merge_labels.cuh:57 merge_labels).
+"""
+
+from raft_tpu.label.classlabels import (
+    get_unique_labels,
+    make_monotonic,
+    get_ovr_labels,
+    merge_labels,
+)
+
+__all__ = [
+    "get_unique_labels",
+    "make_monotonic",
+    "get_ovr_labels",
+    "merge_labels",
+]

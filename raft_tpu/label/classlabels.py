@@ -1,0 +1,108 @@
+"""Class-label utilities — analog of
+cpp/include/raft/label/classlabels.cuh (getUniquelabels:65,
+make_monotonic:103, getOvrlabels:86) and merge_labels.cuh:57.
+
+All jittable with a static capacity on the unique-label count (the usual
+static-shape trade: the reference returns a dynamically sized unique array;
+here the capacity is an argument and the true count a returned scalar).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "get_unique_labels",
+    "make_monotonic",
+    "get_ovr_labels",
+    "merge_labels",
+]
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def get_unique_labels(labels, capacity: Optional[int] = None):
+    """Sorted unique labels (reference getUniquelabels:65).
+
+    Returns (unique (capacity,), n_unique); slots past n_unique are padded
+    with the max label.
+    """
+    labels = jnp.asarray(labels)
+    cap = capacity or labels.shape[0]
+    s = jnp.sort(labels)
+    head = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    n_unique = jnp.sum(head).astype(jnp.int32)
+    order = jnp.argsort(~head, stable=True)  # heads first, still sorted
+    uniq = s[order][:cap]
+    pad = jnp.max(labels)
+    uniq = jnp.where(jnp.arange(cap) < n_unique, uniq, pad)
+    return uniq, n_unique
+
+
+@jax.jit
+def make_monotonic(labels):
+    """Map labels to consecutive ids ordered by label value
+    (reference make_monotonic:103: each label becomes its rank in the
+    sorted unique array)."""
+    labels = jnp.asarray(labels)
+    s = jnp.sort(labels)
+    head = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    # rank of each sorted position's value = #heads before it
+    ranks_sorted = jnp.cumsum(head) - 1
+    # value -> rank lookup via searchsorted on the sorted array: first
+    # occurrence index, then its rank
+    first_pos = jnp.searchsorted(s, labels, side="left")
+    return ranks_sorted[first_pos].astype(jnp.int32)
+
+
+def get_ovr_labels(labels, target, *, dtype=jnp.float32):
+    """One-vs-rest ±1 labels for a target class
+    (reference getOvrlabels:86)."""
+    labels = jnp.asarray(labels)
+    return jnp.where(labels == target, 1, -1).astype(dtype)
+
+
+@jax.jit
+def merge_labels(labels_a, labels_b, mask=None):
+    """Union-merge two labelings of the same points (reference
+    merge_labels.cuh:57, used to stitch partial clusterings in MNMG
+    DBSCAN-style flows): points sharing a label in EITHER input end up with
+    one common label — the min initial label of their merged group.
+
+    ``mask`` optionally limits which points participate in b-induced merges
+    (the reference's core-point mask); masked-out points keep their
+    a-labels unless pulled in via an a-group.
+    """
+    a = jnp.asarray(labels_a).astype(jnp.int32)
+    b = jnp.asarray(labels_b).astype(jnp.int32)
+    n = a.shape[0]
+    if mask is None:
+        mask = jnp.ones((n,), bool)
+    else:
+        mask = jnp.asarray(mask)
+
+    def propagate(cur, group, active):
+        """One min-propagation through a labeling: group members share min."""
+        big = jnp.int32(n + 1)
+        gmin = jnp.full((n,), big, jnp.int32).at[group].min(
+            jnp.where(active, cur, big)
+        )
+        return jnp.where(active, jnp.minimum(cur, gmin[group]), cur)
+
+    def body(state):
+        cur, _ = state
+        nxt = propagate(cur, a, jnp.ones((n,), bool))
+        nxt = propagate(nxt, b, mask)
+        return nxt, jnp.any(nxt != cur)
+
+    def cond(state):
+        return state[1]
+
+    out, _ = lax.while_loop(
+        cond, body, (jnp.arange(n, dtype=jnp.int32), jnp.bool_(True))
+    )
+    return out

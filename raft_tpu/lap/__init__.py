@@ -1,0 +1,8 @@
+"""Linear assignment — analog of raft/lap
+(cpp/include/raft/lap/lap.cuh:44-192 ``LinearAssignmentProblem`` — a batched
+GPU Hungarian (Date–Nagi) state machine).
+"""
+
+from raft_tpu.lap.lap import LinearAssignmentProblem, solve_lap, solve_lap_batched
+
+__all__ = ["LinearAssignmentProblem", "solve_lap", "solve_lap_batched"]

@@ -1,0 +1,27 @@
+"""Spectral graph methods — analog of raft/spectral
+(cpp/include/raft/spectral/: partition.hpp, modularity_maximization.hpp,
+matrix_wrappers.hpp, eigen_solvers.hpp, cluster_solvers.hpp;
+SURVEY.md §2 #24).
+"""
+
+from raft_tpu.spectral.partition import (
+    EigenSolverConfig,
+    ClusterSolverConfig,
+    LaplacianMatrix,
+    ModularityMatrix,
+    partition,
+    analyze_partition,
+    modularity_maximization,
+    analyze_modularity,
+)
+
+__all__ = [
+    "EigenSolverConfig",
+    "ClusterSolverConfig",
+    "LaplacianMatrix",
+    "ModularityMatrix",
+    "partition",
+    "analyze_partition",
+    "modularity_maximization",
+    "analyze_modularity",
+]

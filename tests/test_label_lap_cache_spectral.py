@@ -1,0 +1,208 @@
+"""Tests for label utils, LAP, vector cache, spectral methods
+(reference cpp/test/label/label.cu, cpp/test/lap/lap.cu,
+cpp/test/cluster_solvers.cu / eigen_solvers.cu / spectral_matrix.cu)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.label import (
+    get_unique_labels,
+    make_monotonic,
+    get_ovr_labels,
+    merge_labels,
+)
+from raft_tpu.lap import solve_lap, solve_lap_batched, LinearAssignmentProblem
+from raft_tpu.cache import VectorCache
+
+
+# -- label -------------------------------------------------------------------
+
+
+def test_unique_labels():
+    labels = np.array([5, 2, 9, 2, 5, 5], np.int32)
+    uniq, n = get_unique_labels(labels, capacity=6)
+    assert int(n) == 3
+    np.testing.assert_array_equal(np.asarray(uniq)[:3], [2, 5, 9])
+
+
+def test_make_monotonic():
+    labels = np.array([10, 3, 10, 99, 3], np.int32)
+    out = np.asarray(make_monotonic(labels))
+    # ranks by sorted value: 3->0, 10->1, 99->2
+    np.testing.assert_array_equal(out, [1, 0, 1, 2, 0])
+
+
+def test_ovr_labels():
+    labels = np.array([0, 1, 2, 1], np.int32)
+    out = np.asarray(get_ovr_labels(labels, 1))
+    np.testing.assert_array_equal(out, [-1, 1, -1, 1])
+
+
+def test_merge_labels():
+    # a: {0,1} {2,3}; b: {1,2} {0} {3} -> all connected via 1-2 bridge
+    a = np.array([0, 0, 2, 2], np.int32)
+    b = np.array([0, 1, 1, 3], np.int32)
+    out = np.asarray(merge_labels(a, b))
+    assert len(np.unique(out)) == 1
+    # disjoint labelings stay split
+    a = np.array([0, 0, 2, 2], np.int32)
+    b = np.array([0, 0, 2, 2], np.int32)
+    out = np.asarray(merge_labels(a, b))
+    assert len(np.unique(out)) == 2
+
+
+def test_merge_labels_mask():
+    # mask stops the b-induced bridge
+    a = np.array([0, 0, 2, 2], np.int32)
+    b = np.array([0, 1, 1, 3], np.int32)
+    mask = np.array([True, False, False, True])
+    out = np.asarray(merge_labels(a, b, mask))
+    assert len(np.unique(out)) == 2
+
+
+# -- LAP ---------------------------------------------------------------------
+
+
+def brute_force_lap(cost):
+    n = cost.shape[0]
+    best, best_perm = np.inf, None
+    for perm in itertools.permutations(range(n)):
+        v = cost[np.arange(n), perm].sum()
+        if v < best:
+            best, best_perm = v, perm
+    return best, np.array(best_perm)
+
+
+@pytest.mark.parametrize("n", [3, 5, 7])
+def test_lap_optimal_small(n, rng_np):
+    for _ in range(3):
+        cost = rng_np.random((n, n)).astype(np.float32)
+        assign, total = solve_lap(cost)
+        assign = np.asarray(assign)
+        # valid permutation
+        assert sorted(assign) == list(range(n))
+        want, _ = brute_force_lap(cost)
+        np.testing.assert_allclose(float(total), want, rtol=1e-3, atol=1e-3)
+
+
+def test_lap_maximize(rng_np):
+    cost = rng_np.random((6, 6)).astype(np.float32)
+    assign, total = solve_lap(cost, maximize=True)
+    want, _ = brute_force_lap(-cost)
+    np.testing.assert_allclose(float(total), -want, rtol=1e-3, atol=1e-3)
+
+
+def test_lap_batched(rng_np):
+    costs = rng_np.random((4, 5, 5)).astype(np.float32)
+    rows, objs = solve_lap_batched(costs)
+    for b in range(4):
+        want, _ = brute_force_lap(costs[b])
+        np.testing.assert_allclose(float(objs[b]), want, rtol=1e-3, atol=1e-3)
+    lapobj = LinearAssignmentProblem(5, 4)
+    rows2, objs2 = lapobj.solve(costs)
+    np.testing.assert_allclose(np.asarray(objs), np.asarray(objs2))
+
+
+def test_lap_identity():
+    # diagonal much cheaper than off-diagonal
+    cost = np.ones((8, 8), np.float32) * 10 - 9 * np.eye(8, dtype=np.float32)
+    assign, total = solve_lap(cost)
+    np.testing.assert_array_equal(np.asarray(assign), np.arange(8))
+    np.testing.assert_allclose(float(total), 8.0, rtol=1e-4)
+
+
+# -- cache -------------------------------------------------------------------
+
+
+def test_cache_roundtrip(rng_np):
+    cache = VectorCache(dim=4, n_sets=8, associativity=2)
+    keys = np.arange(10, dtype=np.int32)
+    vecs = rng_np.standard_normal((10, 4)).astype(np.float32)
+    cache.store_vecs(keys, vecs)
+    got, found = cache.get_vecs(keys)
+    found = np.asarray(found)
+    got = np.asarray(got)
+    assert found.sum() >= 8  # some sets may have collided (2-way, 8 sets)
+    for i in np.nonzero(found)[0]:
+        np.testing.assert_allclose(got[i], vecs[i])
+    # misses report not-found
+    _, found2 = cache.get_vecs(np.array([1000, 2000], np.int32))
+    assert not np.asarray(found2).any()
+
+
+def test_cache_lru_eviction(rng_np):
+    cache = VectorCache(dim=2, n_sets=1, associativity=2)
+    v = rng_np.standard_normal((3, 2)).astype(np.float32)
+    cache.store_vecs(np.array([0], np.int32), v[:1])
+    cache.store_vecs(np.array([1], np.int32), v[1:2])
+    cache.get_vecs(np.array([0], np.int32))       # touch 0 -> 1 becomes LRU
+    cache.store_vecs(np.array([2], np.int32), v[2:])
+    _, f0 = cache.get_vecs(np.array([0], np.int32))
+    _, f1 = cache.get_vecs(np.array([1], np.int32))
+    _, f2 = cache.get_vecs(np.array([2], np.int32))
+    assert bool(np.asarray(f0)[0]) and bool(np.asarray(f2)[0])
+    assert not bool(np.asarray(f1)[0])
+
+
+# -- spectral ----------------------------------------------------------------
+
+
+def two_clique_graph(n_per=8, bridge_w=0.01):
+    n = 2 * n_per
+    dense = np.zeros((n, n), np.float32)
+    for grp in (range(n_per), range(n_per, n)):
+        for i in grp:
+            for j in grp:
+                if i != j:
+                    dense[i, j] = 1.0
+    dense[n_per - 1, n_per] = dense[n_per, n_per - 1] = bridge_w
+    return dense
+
+
+def test_spectral_partition():
+    from raft_tpu.sparse import coo_from_dense, csr_from_coo
+    from raft_tpu.spectral import (
+        EigenSolverConfig,
+        ClusterSolverConfig,
+        partition,
+        analyze_partition,
+    )
+
+    dense = two_clique_graph()
+    csr = csr_from_coo(coo_from_dense(dense))
+    res = partition(
+        csr, EigenSolverConfig(n_eig_vecs=2), ClusterSolverConfig(n_clusters=2)
+    )
+    labels = np.asarray(res.labels)
+    assert len(np.unique(labels)) == 2
+    # the cut must split the two cliques (bridge is the only cross edge)
+    assert len(np.unique(labels[:8])) == 1
+    assert len(np.unique(labels[8:])) == 1
+    edge_cut, cost = analyze_partition(csr, res.labels, 2)
+    np.testing.assert_allclose(float(edge_cut), 0.01, atol=1e-4)
+
+
+def test_modularity_maximization():
+    from raft_tpu.sparse import coo_from_dense, csr_from_coo
+    from raft_tpu.spectral import (
+        EigenSolverConfig,
+        ClusterSolverConfig,
+        modularity_maximization,
+        analyze_modularity,
+    )
+
+    dense = two_clique_graph(bridge_w=0.5)
+    csr = csr_from_coo(coo_from_dense(dense))
+    res = modularity_maximization(
+        csr, EigenSolverConfig(n_eig_vecs=2), ClusterSolverConfig(n_clusters=2)
+    )
+    labels = np.asarray(res.labels)
+    q = float(analyze_modularity(csr, res.labels))
+    # good community structure: Q close to 0.5 for two equal cliques
+    assert q > 0.3
+    assert len(np.unique(labels[:8])) == 1
+    assert len(np.unique(labels[8:])) == 1
