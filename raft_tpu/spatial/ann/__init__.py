@@ -1,0 +1,39 @@
+"""ANN indexes — first-class TPU implementations (the reference wraps FAISS,
+cpp/include/raft/spatial/knn/detail/ann_quantized_faiss.cuh; SURVEY.md §2
+#19-20 mandates native IVF here): IVF-Flat, IVF-PQ, IVF-SQ, random ball
+cover, all on a shared sorted-by-list storage layout.
+"""
+
+from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
+from raft_tpu.spatial.ann.ivf_flat import (
+    IVFFlatParams,
+    IVFFlatIndex,
+    ivf_flat_build,
+    ivf_flat_search,
+)
+from raft_tpu.spatial.ann.ivf_pq import (
+    IVFPQParams,
+    IVFPQIndex,
+    ivf_pq_build,
+    ivf_pq_search,
+)
+from raft_tpu.spatial.ann.ivf_sq import (
+    IVFSQParams,
+    IVFSQIndex,
+    ivf_sq_build,
+    ivf_sq_search,
+)
+from raft_tpu.spatial.ann.ball_cover import (
+    BallCoverIndex,
+    rbc_build_index,
+    rbc_knn_query,
+    rbc_all_knn_query,
+)
+
+__all__ = [
+    "ListStorage", "build_list_storage",
+    "IVFFlatParams", "IVFFlatIndex", "ivf_flat_build", "ivf_flat_search",
+    "IVFPQParams", "IVFPQIndex", "ivf_pq_build", "ivf_pq_search",
+    "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
+    "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",
+]

@@ -1,0 +1,128 @@
+"""Random ball cover — analog of
+cpp/include/raft/spatial/knn/ball_cover.cuh:34-144 (``BallCoverIndex``
+ball_cover_common.h:38-90, rbc_build_index / rbc_knn_query /
+rbc_all_knn_query; registers kernels detail/ball_cover/registers.cuh).
+
+Build (reference rbc_build_index): sample √n landmarks (k-means refined),
+assign every point to its closest landmark (the "ball"), store balls with
+the shared sorted-list layout, record per-ball radii.
+
+Query (reference's two-pass triangle-inequality strategy): balls are probed
+in order of d(q, landmark); a ball can contain a better neighbor only if
+d(q, L) - radius_L < kth_best, so after scoring the closest ``n_probes``
+balls the kth distance certifies, per query, whether the result is exact.
+``rbc_knn_query`` returns that certificate mask; with
+``n_probes = n_landmarks`` the search is exhaustively exact (the
+reference's guarantee)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
+
+__all__ = ["BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BallCoverIndex:
+    """Analog of BallCoverIndex (ball_cover_common.h:38)."""
+
+    landmarks: jax.Array      # (n_landmarks, d)
+    radii: jax.Array          # (n_landmarks,)
+    data_sorted: jax.Array    # (n + 1, d) sentinel row appended
+    storage: ListStorage
+
+
+def rbc_build_index(x, *, n_landmarks: int = 0, seed: int = 0) -> BallCoverIndex:
+    """Build (reference rbc_build_index, ball_cover.cuh:34): √n landmarks
+    by default."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    if n_landmarks <= 0:
+        n_landmarks = max(int(np.sqrt(n)), 1)
+    out = kmeans_fit(
+        x, KMeansParams(n_clusters=n_landmarks, max_iter=10, seed=seed)
+    )
+    labels = out.labels
+    storage = build_list_storage(np.asarray(labels), n_landmarks)
+    data_sorted = jnp.concatenate(
+        [x[storage.sorted_ids], jnp.zeros((1, x.shape[1]), x.dtype)]
+    )
+    # radius of each ball: max member distance to its landmark
+    d2 = jnp.sum((x - out.centroids[labels]) ** 2, axis=1)
+    radii = jnp.sqrt(
+        jnp.zeros((n_landmarks,), jnp.float32).at[labels].max(d2)
+    )
+    return BallCoverIndex(out.centroids, radii, data_sorted, storage)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes"))
+def rbc_knn_query(
+    index: BallCoverIndex, queries, k: int, *, n_probes: int = 16
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """kNN query. Returns (dists (L2), ids, exact (nq,) bool certificate).
+
+    exact[i] is True when the triangle inequality proves no unprobed ball
+    can contain a closer neighbor — the reference's pruning criterion
+    (detail/ball_cover.cuh perform_post_filter_registers) used here as a
+    per-query certificate."""
+    q = jnp.asarray(queries)
+    nq, d = q.shape
+    n_land = index.landmarks.shape[0]
+    n_probes = min(n_probes, n_land)
+    if k > n_probes * index.storage.max_list:
+        raise ValueError("k exceeds candidate pool; raise n_probes")
+    f32 = jnp.float32
+    qf = q.astype(f32)
+    lm = index.landmarks.astype(f32)
+
+    qn = jnp.sum(qf * qf, axis=1)
+    ln = jnp.sum(lm * lm, axis=1)
+    g = lax.dot_general(qf, lm, (((1,), (1,)), ((), ())),
+                        preferred_element_type=f32)
+    ld = jnp.sqrt(jnp.maximum(qn[:, None] + ln[None, :] - 2.0 * g, 0.0))
+    neg, probes = lax.top_k(-ld, n_probes)                  # closest balls
+
+    cand_pos = index.storage.list_index[probes].reshape(nq, -1)
+    cand = index.data_sorted[cand_pos].astype(f32)
+    valid = cand_pos < index.storage.n
+    cvn = jnp.sum(cand * cand, axis=2)
+    dots = jnp.einsum("qcd,qd->qc", cand, qf, preferred_element_type=f32)
+    d2 = jnp.where(valid, qn[:, None] + cvn - 2.0 * dots, jnp.inf)
+
+    vals, pos = lax.top_k(-d2, k)
+    dists = jnp.sqrt(jnp.maximum(-vals, 0.0))
+    ids = index.storage.sorted_ids[
+        jnp.clip(jnp.take_along_axis(cand_pos, pos, axis=1), 0,
+                 index.storage.n - 1)
+    ]
+    ids = jnp.where(jnp.isfinite(-vals), ids, -1)
+
+    # exactness certificate: every UNPROBED ball satisfies
+    # d(q, L) - radius_L >= kth  (probed balls were fully scored)
+    kth = dists[:, k - 1]
+    probed = jnp.zeros((nq, n_land), bool).at[
+        jnp.arange(nq)[:, None], probes
+    ].set(True)
+    bound = ld - index.radii[None, :]
+    exact = jnp.all(probed | (bound >= kth[:, None]), axis=1)
+    return dists, ids.astype(jnp.int32), exact
+
+
+def rbc_all_knn_query(index: BallCoverIndex, k: int, *, n_probes: int = 16):
+    """All-points kNN over the index's own data
+    (reference rbc_all_knn_query, ball_cover.cuh:69)."""
+    x = index.data_sorted[: index.storage.n]
+    # un-permute so row i queries original point i
+    inv = jnp.argsort(index.storage.sorted_ids)
+    return rbc_knn_query(index, x[inv], k, n_probes=n_probes)

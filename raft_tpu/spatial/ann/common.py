@@ -1,0 +1,63 @@
+"""Shared inverted-list storage — the TPU-native layout under every ANN
+index (analog of the FAISS inverted lists the reference wraps,
+cpp/include/raft/spatial/knn/detail/ann_quantized_faiss.cuh + ann_common.h;
+here first-class, no FAISS).
+
+Layout decision (hard part №3, SURVEY.md §7: "irregular gathers →
+sorted-by-list batching"): vectors are permuted so each list is contiguous,
+plus a dense (n_lists, max_list_size) row-id matrix padded with a sentinel.
+Probing gathers whole padded lists — rectangular, static-shape, MXU-friendly
+— and masks sentinel slots with +inf at scoring time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ListStorage", "build_list_storage"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ListStorage:
+    """Sorted-by-list container.
+
+    sorted_ids[i] = original row id of the i-th vector in list-sorted order;
+    list_index[l, j] = position (into the sorted order) of the j-th member
+    of list l, or ``n`` (sentinel) when padded.
+    """
+
+    sorted_ids: jax.Array     # (n,) int32
+    list_offsets: jax.Array   # (n_lists + 1,) int32
+    list_index: jax.Array     # (n_lists, max_list) int32, sentinel = n
+    list_sizes: jax.Array     # (n_lists,) int32
+    n: int = dataclasses.field(metadata=dict(static=True))
+    max_list: int = dataclasses.field(metadata=dict(static=True))
+
+
+def build_list_storage(assignments, n_lists: int) -> ListStorage:
+    """Host-side build (index construction is offline, like the reference's
+    index build path)."""
+    a = np.asarray(assignments)
+    n = a.shape[0]
+    order = np.argsort(a, kind="stable").astype(np.int32)
+    sizes = np.bincount(a, minlength=n_lists).astype(np.int32)
+    offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32)
+    max_list = max(int(sizes.max()), 1)
+    list_index = np.full((n_lists, max_list), n, np.int32)
+    for l in range(n_lists):
+        cnt = sizes[l]
+        list_index[l, :cnt] = np.arange(offsets[l], offsets[l] + cnt)
+    return ListStorage(
+        jnp.asarray(order),
+        jnp.asarray(offsets),
+        jnp.asarray(list_index),
+        jnp.asarray(sizes),
+        n,
+        max_list,
+    )

@@ -1,0 +1,101 @@
+"""IVF-SQ (scalar quantization) — analog of the reference's
+GpuIndexIVFScalarQuantizer wrap (ann_quantized_faiss.cuh:143-160
+``QuantizerType`` QT_8bit family; native here).
+
+Vectors are affinely mapped to int8 per dimension (global min/max train
+pass, the QT_8bit scheme); lists and search reuse the IVF-Flat machinery
+with dequantization fused into the candidate scoring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
+
+__all__ = ["IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IVFSQParams:
+    n_lists: int = 64
+    kmeans_n_iters: int = 20
+    seed: int = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class IVFSQIndex:
+    centroids: jax.Array      # (n_lists, d)
+    codes_sorted: jax.Array   # (n + 1, d) int8
+    vmin: jax.Array           # (d,)
+    vscale: jax.Array         # (d,)
+    storage: ListStorage
+
+
+def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
+    x = jnp.asarray(x)
+    out = kmeans_fit(
+        x,
+        KMeansParams(
+            n_clusters=params.n_lists,
+            max_iter=params.kmeans_n_iters,
+            seed=params.seed,
+        ),
+    )
+    vmin = jnp.min(x, axis=0)
+    vmax = jnp.max(x, axis=0)
+    vscale = jnp.maximum(vmax - vmin, 1e-12) / 255.0
+    codes = jnp.clip(
+        jnp.round((x - vmin[None, :]) / vscale[None, :]) - 128, -128, 127
+    ).astype(jnp.int8)
+    storage = build_list_storage(np.asarray(out.labels), params.n_lists)
+    codes_sorted = jnp.concatenate(
+        [codes[storage.sorted_ids], jnp.zeros((1, x.shape[1]), jnp.int8)]
+    )
+    return IVFSQIndex(out.centroids, codes_sorted, vmin, vscale, storage)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes"))
+def ivf_sq_search(
+    index: IVFSQIndex, queries, k: int, *, n_probes: int = 8
+) -> Tuple[jax.Array, jax.Array]:
+    q = jnp.asarray(queries)
+    nq, d = q.shape
+    if k > n_probes * index.storage.max_list:
+        raise ValueError("k exceeds candidate pool; raise n_probes")
+    f32 = jnp.float32
+    qf = q.astype(f32)
+    cents = index.centroids.astype(f32)
+
+    qn = jnp.sum(qf * qf, axis=1)
+    cn = jnp.sum(cents * cents, axis=1)
+    gc = lax.dot_general(qf, cents, (((1,), (1,)), ((), ())),
+                         preferred_element_type=f32)
+    _, probes = lax.top_k(-(qn[:, None] + cn[None, :] - 2.0 * gc), n_probes)
+
+    cand_pos = index.storage.list_index[probes].reshape(nq, -1)
+    codes = index.codes_sorted[cand_pos].astype(f32)         # (q, C, d)
+    cand = (codes + 128.0) * index.vscale[None, None, :] + index.vmin[None, None, :]
+    valid = cand_pos < index.storage.n
+
+    cvn = jnp.sum(cand * cand, axis=2)
+    dots = jnp.einsum("qcd,qd->qc", cand, qf, preferred_element_type=f32)
+    d2 = jnp.where(valid, qn[:, None] + cvn - 2.0 * dots, jnp.inf)
+
+    vals, pos = lax.top_k(-d2, k)
+    vals = -vals
+    ids = index.storage.sorted_ids[
+        jnp.clip(jnp.take_along_axis(cand_pos, pos, axis=1), 0,
+                 index.storage.n - 1)
+    ]
+    ids = jnp.where(jnp.isfinite(vals), ids, -1)
+    return vals, ids.astype(jnp.int32)

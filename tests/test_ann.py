@@ -1,0 +1,116 @@
+"""ANN index tests — recall-vs-brute-force oracles (the reference tests
+ball cover against brute-force kNN, cpp/test/spatial/ball_cover.cu, and
+relies on FAISS's own tests for IVF; here every index is native so each
+gets a recall/exactness harness)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.spatial import brute_force_knn
+from raft_tpu.spatial.ann import (
+    ivf_flat_build, ivf_flat_search, IVFFlatParams,
+    ivf_pq_build, ivf_pq_search, IVFPQParams,
+    ivf_sq_build, ivf_sq_search, IVFSQParams,
+    rbc_build_index, rbc_knn_query, rbc_all_knn_query,
+)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(3)
+    # clustered data (ANN-friendly) + uniform noise
+    centers = rng.standard_normal((20, 16)) * 5
+    x = np.concatenate(
+        [c + 0.5 * rng.standard_normal((100, 16)) for c in centers]
+    ).astype(np.float32)
+    queries = x[rng.choice(len(x), 50, replace=False)] + 0.05 * rng.standard_normal(
+        (50, 16)
+    ).astype(np.float32)
+    return x.astype(np.float32), queries.astype(np.float32)
+
+
+def recall(got_ids, want_ids):
+    hits = 0
+    for g, w in zip(got_ids, want_ids):
+        hits += len(set(g.tolist()) & set(w.tolist()))
+    return hits / want_ids.size
+
+
+def test_ivf_flat_recall(dataset):
+    x, q = dataset
+    index = ivf_flat_build(x, IVFFlatParams(n_lists=32, seed=0))
+    d, i = ivf_flat_search(index, q, 10, n_probes=8)
+    bd, bi = brute_force_knn(x, q, 10, metric="l2")
+    r = recall(np.asarray(i), np.asarray(bi))
+    assert r > 0.95, r
+    # distances are true L2 distances of the returned ids
+    row = np.linalg.norm(x[np.asarray(i)[0, 0]] - q[0])
+    np.testing.assert_allclose(np.asarray(d)[0, 0], row, rtol=1e-3, atol=1e-3)
+
+
+def test_ivf_flat_full_probe_exact(dataset):
+    x, q = dataset
+    index = ivf_flat_build(x, IVFFlatParams(n_lists=16, seed=0))
+    d, i = ivf_flat_search(index, q, 5, n_probes=16)  # all lists
+    bd, bi = brute_force_knn(x, q, 5, metric="l2")
+    assert recall(np.asarray(i), np.asarray(bi)) == 1.0
+    np.testing.assert_allclose(np.asarray(d), np.asarray(bd), rtol=1e-3, atol=1e-3)
+
+
+def test_ivf_pq_recall(dataset):
+    x, q = dataset
+    index = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=8, seed=0))
+    d, i = ivf_pq_search(index, q, 10, n_probes=8)
+    _, bi = brute_force_knn(x, q, 10, metric="l2")
+    r = recall(np.asarray(i), np.asarray(bi))
+    assert r > 0.6, r  # quantized: lossy but far above chance (10/2000)
+
+
+def test_ivf_pq_codes_shapes(dataset):
+    x, _ = dataset
+    index = ivf_pq_build(x, IVFPQParams(n_lists=8, pq_dim=4, pq_bits=6))
+    assert index.codebooks.shape == (4, 64, 4)
+    assert index.codes_sorted.shape == (len(x) + 1, 4)
+    assert int(np.asarray(index.codes_sorted).max()) < 64
+
+
+def test_ivf_sq_recall(dataset):
+    x, q = dataset
+    index = ivf_sq_build(x, IVFSQParams(n_lists=16, seed=0))
+    d, i = ivf_sq_search(index, q, 10, n_probes=16)  # all lists -> SQ error only
+    _, bi = brute_force_knn(x, q, 10, metric="l2")
+    r = recall(np.asarray(i), np.asarray(bi))
+    assert r > 0.9, r
+
+
+def test_ball_cover_certified_exact(dataset):
+    x, q = dataset
+    index = rbc_build_index(x, seed=0)
+    d, i, exact = rbc_knn_query(index, q, 5, n_probes=20)
+    bd, bi = brute_force_knn(x, q, 5, metric="l2")
+    ex = np.asarray(exact)
+    # certified-exact queries must match brute force exactly
+    for qi in np.nonzero(ex)[0]:
+        np.testing.assert_allclose(
+            np.asarray(d)[qi], np.asarray(bd)[qi], rtol=1e-3, atol=1e-3
+        )
+    # and most queries should certify with 20 of ~45 balls probed
+    assert ex.mean() > 0.7, ex.mean()
+    assert recall(np.asarray(i), np.asarray(bi)) > 0.95
+
+
+def test_ball_cover_all_probes_exact(dataset):
+    x, q = dataset
+    index = rbc_build_index(x, n_landmarks=12, seed=0)
+    d, i, exact = rbc_knn_query(index, q, 5, n_probes=12)
+    assert np.asarray(exact).all()
+    _, bi = brute_force_knn(x, q, 5, metric="l2")
+    assert recall(np.asarray(i), np.asarray(bi)) == 1.0
+
+
+def test_ball_cover_all_knn(dataset):
+    x, _ = dataset
+    index = rbc_build_index(x, n_landmarks=10, seed=0)
+    d, i, exact = rbc_all_knn_query(index, 4, n_probes=10)
+    # each point's nearest neighbor is itself
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(len(x)))
