@@ -2,7 +2,11 @@
 
 Mirrors the reference's distance benchmark (cpp/bench/distance/distance_exp_l2.cu
 via the shared harness cpp/bench/distance/distance_common.cuh): time the
-expanded-L2 pairwise distance engine on a large square problem.
+expanded-L2 pairwise distance engine on a large square problem, using the
+shared loop-in-jit harness (bench/common.py — per-dispatch latency through
+the axon tunnel is ~10 ms, so host-side loops measure the tunnel, not the
+chip; a full-output reduce pins the dependence so XLA cannot narrow the
+measured computation).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -10,20 +14,16 @@ vs_baseline is value / 10_000 GFLOPS — a RAFT-on-A100 estimate for the f32
 pairwise-distance suite (the reference publishes no absolute numbers;
 BASELINE.md records `"published": {}`), i.e. vs_baseline >= 1.0 means we beat
 the A100 reference estimate.
-
-Timing methodology: the repeat loop lives INSIDE one jit (lax.fori_loop) —
-per-dispatch latency through the axon tunnel is ~10 ms, so host-side loops
-measure the tunnel, not the chip.
 """
 
+import contextlib
+import io
 import json
-import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
+from bench.common import bench_fn
 from raft_tpu.distance.pairwise import _expanded_impl
 from raft_tpu.distance.distance_type import DistanceType
 
@@ -31,35 +31,25 @@ from raft_tpu.distance.distance_type import DistanceType
 def main():
     m = n = 8192
     d = 512
-    iters = 20
 
     rng = np.random.default_rng(42)
-    # TPU-idiomatic: bf16 operands, f32 MXU accumulation (preferred_element_type)
-    x = jax.device_put(rng.standard_normal((m, d)).astype(jnp.bfloat16))
-    y = jax.device_put(rng.standard_normal((n, d)).astype(jnp.bfloat16))
+    # f32 operands + default MXU precision: measured fastest on v5e (the
+    # bf16-input path currently hits an XLA layout-conversion slowdown —
+    # see bench/bench_distance.py for the full grid)
+    x = jax.device_put(rng.standard_normal((m, d)).astype(np.float32))
+    y = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
 
-    @jax.jit
-    def loop(x, y):
-        def body(i, acc):
-            dmat = _expanded_impl(
-                DistanceType.L2Expanded, x + i * 0.0, y, "default"
-            )
-            # full-matrix reduce pins the dependence on every output element;
-            # a sliced read would let XLA narrow the dot to two rows and
-            # overstate GFLOPS by orders of magnitude.
-            return acc + jnp.sum(dmat)
-        return lax.fori_loop(0, iters, body, jnp.float32(0.0))
+    with contextlib.redirect_stdout(io.StringIO()):  # suppress harness line
+        ms = bench_fn(
+            lambda a, b: _expanded_impl(DistanceType.L2Expanded, a, b, "default"),
+            x, y, iters=20, name="headline",
+        )
 
-    loop(x, y).block_until_ready()  # compile
-    t0 = time.perf_counter()
-    float(loop(x, y))
-    dt = (time.perf_counter() - t0) / iters
-
-    gflops = 2.0 * m * n * d / dt / 1e9
+    gflops = 2.0 * m * n * d / (ms / 1e3) / 1e9
     print(
         json.dumps(
             {
-                "metric": "pairwise_l2_expanded_8192x8192x512_bf16",
+                "metric": "pairwise_l2_expanded_8192x8192x512_f32",
                 "value": round(gflops, 1),
                 "unit": "GFLOPS",
                 "vs_baseline": round(gflops / 10_000.0, 3),

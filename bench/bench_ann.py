@@ -1,0 +1,51 @@
+"""ANN benchmarks — IVF-Flat/IVF-PQ build + search (the reference's
+IVF suites run through FAISS, ann_quantized_faiss.cuh; BASELINE.md names
+IVF build+search as a target config)."""
+
+import json
+import time
+
+import numpy as np
+import jax
+
+from raft_tpu.spatial.ann import (
+    IVFFlatParams, ivf_flat_build, ivf_flat_search,
+    IVFPQParams, ivf_pq_build, ivf_pq_search,
+)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d, nq, k = 500_000, 96, 4096, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = jax.device_put(rng.standard_normal((nq, d)).astype(np.float32))
+
+    for name, build, search, params in [
+        ("ivf_flat", ivf_flat_build, ivf_flat_search,
+         IVFFlatParams(n_lists=1024, kmeans_n_iters=10)),
+        ("ivf_pq", ivf_pq_build, ivf_pq_search,
+         IVFPQParams(n_lists=1024, pq_dim=12, kmeans_n_iters=10)),
+    ]:
+        t0 = time.perf_counter()
+        index = build(x, params)
+        jax.block_until_ready(jax.tree.leaves(index)[0])
+        build_s = time.perf_counter() - t0
+
+        d_, i_ = search(index, q, k, n_probes=32)  # compile
+        jax.block_until_ready(d_)
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            d_, i_ = search(index, q, k, n_probes=32)
+        jax.block_until_ready(d_)
+        search_s = (time.perf_counter() - t0) / reps
+        print(json.dumps({
+            "name": f"ann/{name}/{n}x{d}",
+            "build_s": round(build_s, 2),
+            "search_ms": round(search_s * 1e3, 2),
+            "qps": round(nq / search_s),
+        }))
+
+
+if __name__ == "__main__":
+    main()

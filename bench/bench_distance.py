@@ -1,0 +1,48 @@
+"""Pairwise-distance benchmarks — mirrors cpp/bench/distance/
+distance_{exp_l2,unexp_l2,cosine,l1}.cu (shapes from the
+DIST_BENCH_REGISTER grid) + fused_l2_nn.cu."""
+
+import numpy as np
+import jax
+
+from bench.common import bench_fn
+from raft_tpu.distance.pairwise import _expanded_impl, _unexpanded_impl
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.distance.pallas_kernels import pallas_pairwise
+
+
+def main():
+    rng = np.random.default_rng(0)
+    shapes = [(1024, 1024, 256), (4096, 4096, 512), (8192, 8192, 512)]
+    for m, n, d in shapes:
+        x = jax.device_put(rng.standard_normal((m, d)).astype(np.float32))
+        y = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
+        flops = 2.0 * m * n * d
+        bench_fn(
+            lambda a, b: _expanded_impl(DistanceType.L2Expanded, a, b, "default"),
+            x, y, name=f"distance/l2_exp/{m}x{n}x{d}", work=flops,
+        )
+        bench_fn(
+            lambda a, b: _expanded_impl(DistanceType.CosineExpanded, a, b, "default"),
+            x, y, name=f"distance/cosine/{m}x{n}x{d}", work=flops,
+        )
+        if m <= 4096:
+            bench_fn(
+                lambda a, b: _unexpanded_impl(DistanceType.L1, a, b, 2.0, None),
+                x, y, name=f"distance/l1_xla/{m}x{n}x{d}", work=m * n * d,
+                unit="Gop/s",
+            )
+            bench_fn(
+                lambda a, b: pallas_pairwise(a, b, DistanceType.L1),
+                x, y, name=f"distance/l1_pallas/{m}x{n}x{d}", work=m * n * d,
+                unit="Gop/s",
+            )
+        bench_fn(
+            lambda a, b: fused_l2_nn(a, b)[0],
+            x, y, name=f"distance/fused_l2_nn/{m}x{n}x{d}", work=flops,
+        )
+
+
+if __name__ == "__main__":
+    main()

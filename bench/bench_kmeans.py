@@ -1,0 +1,37 @@
+"""k-means benchmark — the BASELINE.md config (make_blobs 1M x 128,
+k=1024; reference cpp/include/raft/cluster/detail/kmeans.cuh:780 loop)."""
+
+import json
+import time
+
+import numpy as np
+import jax
+
+from raft_tpu.cluster import KMeansParams, kmeans_fit
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d, k = 1_000_000, 128, 1024
+    x = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
+
+    iters = 5
+    out = kmeans_fit(x, KMeansParams(n_clusters=k, max_iter=2, seed=0))
+    jax.block_until_ready(out.centroids)  # compile + init
+    t0 = time.perf_counter()
+    out = kmeans_fit(
+        x, KMeansParams(n_clusters=k, max_iter=iters, tol=0.0, seed=0)
+    )
+    jax.block_until_ready(out.centroids)
+    dt = time.perf_counter() - t0
+    per_iter = dt / max(int(out.n_iter), 1)
+    print(json.dumps({
+        "name": f"kmeans/{n}x{d}k{k}",
+        "s_per_iter": round(per_iter, 3),
+        "iters_per_s": round(1.0 / per_iter, 3),
+        "n_iter": int(out.n_iter),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""kNN + selection benchmarks — mirrors cpp/bench/spatial/knn.cu:34-60
+({n_samples, n_dims, n_queries, k} grid, BUILD/SEARCH scopes) and
+selection.cu (SelectKAlgo variants)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bench.common import bench_fn
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.spatial.knn import _knn_single_part
+from raft_tpu.spatial.selection import select_k, SelectKAlgo
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # brute-force search: SIFT-ish config (1M x 128 scaled to chip memory)
+    for n, d, nq, k in [(100_000, 128, 1024, 10), (1_000_000, 96, 256, 10)]:
+        index = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
+        q = jax.device_put(rng.standard_normal((nq, d)).astype(np.float32))
+        ms = bench_fn(
+            lambda a, b: _knn_single_part(
+                a, b, k, DistanceType.L2SqrtExpanded, 2.0, 65536, None
+            )[0],
+            q, index,
+            name=f"knn/bf_search/{n}x{d}q{nq}k{k}", iters=5,
+            work=2.0 * n * d * nq,
+        )
+        print(f'{{"name": "knn/qps/{n}x{d}", "qps": {round(nq / (ms / 1e3))}}}')
+
+    # k-selection algos (selection.cu)
+    dists = jax.device_put(rng.standard_normal((4096, 16384)).astype(np.float32))
+    for algo in (SelectKAlgo.TOPK, SelectKAlgo.SORT):
+        bench_fn(
+            lambda dm: select_k(dm, 64, algo=algo)[0],
+            dists, name=f"selection/{algo.name}/4096x16384k64", iters=10,
+        )
+
+
+if __name__ == "__main__":
+    main()

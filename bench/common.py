@@ -1,0 +1,57 @@
+"""Benchmark harness — analog of cpp/bench/common/benchmark.hpp
+(fixture + cuda_event_timer). TPU methodology: the repeat loop lives inside
+ONE jit (lax.fori_loop) because per-dispatch latency through the axon
+tunnel (~10 ms) would otherwise dominate; a full-output reduce pins the
+dependence so XLA cannot dead-code or narrow the measured computation.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def bench_fn(make_fn: Callable, *args, iters: int = 20, name: str = "",
+             work: float = 0.0, unit: str = "GFLOPS"):
+    """Time ``make_fn(*args)`` inside a fori_loop; returns ms/iter and
+    prints one JSON line {name, ms, value, unit}."""
+
+    @jax.jit
+    def loop(*a):
+        def body(i, acc):
+            # perturb float inputs by i*0 so XLA cannot hoist the whole
+            # computation out of the loop as loop-invariant
+            def bump(x):
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                    return x + jnp.asarray(i, x.dtype) * jnp.asarray(0, x.dtype)
+                return x
+
+            out = make_fn(*jax.tree.map(bump, a))
+            leaves = [
+                jnp.sum(l.astype(jnp.float32))
+                for l in jax.tree.leaves(out)
+                if hasattr(l, "astype")
+            ]
+            return acc + sum(leaves)
+        return lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+    loop(*args).block_until_ready()  # compile
+    # best-of-3: the first timed run per process pays a large one-time
+    # runtime warmup through the axon tunnel
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(loop(*args))
+        best = min(best, time.perf_counter() - t0)
+    ms = best / iters * 1e3
+    rec = {"name": name, "ms_per_iter": round(ms, 4)}
+    if work:
+        rec["value"] = round(work / (ms / 1e3) / 1e9, 2)
+        rec["unit"] = unit
+    print(json.dumps(rec))
+    return ms

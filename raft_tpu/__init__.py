@@ -2,31 +2,74 @@
 
 A from-scratch JAX/XLA/Pallas framework providing the capability surface of
 RAPIDS RAFT (reference: /root/reference, RAPIDS 22.06): dense & sparse linear
-algebra, pairwise distances, k-nearest-neighbors (brute-force + ANN),
-clustering, solvers, statistics, counter-based RNG, and a multi-chip
-communication layer over ICI/DCN via ``jax.sharding`` + ``shard_map``.
+algebra, pairwise distances, k-nearest-neighbors (brute-force + native ANN),
+clustering (kmeans, single-linkage, spectral), solvers, statistics,
+counter-based RNG, linear assignment, and a multi-chip communication layer
+over ICI/DCN via ``jax.sharding`` + ``shard_map``.
 
 Architecture is TPU-first, not a CUDA translation:
 
-* matmul-shaped work (expanded distances, kmeans update, PQ scoring) rides the
-  MXU via ``jax.lax.dot_general`` in bf16/f32;
-* non-GEMM metrics use tiled Pallas VPU kernels (``raft_tpu.ops``);
+* matmul-shaped work (expanded distances, kmeans update, PQ scoring, cov,
+  contingency) rides the MXU via ``lax.dot_general`` with f32 accumulation;
+* non-GEMM metrics use XLA broadcast-reduce fusion or tiled Pallas VPU
+  kernels (``raft_tpu.distance.pallas_pairwise``);
+* irregular algorithms (MST, union-merge, auction LAP) are segment-scatter
+  + pointer-jumping formulations, not thread-divergent ports;
+* sparse data lives in static-capacity padded COO/CSR pytrees; sparse
+  distances densify row blocks onto the dense engine (no hash tables);
 * multi-device scaling uses a ``Mesh`` + XLA collectives (psum/all_gather/
-  ppermute) instead of NCCL/UCX (reference: cpp/include/raft/comms/);
-* the resource handle (reference: cpp/include/raft/core/handle.hpp) becomes a
-  light ``Resources`` object carrying device, mesh and compile options —
-  streams/cublas handles have no TPU analog; XLA owns scheduling.
+  ppermute) behind a ``comms_t``-shaped facade instead of NCCL/UCX
+  (reference: cpp/include/raft/comms/);
+* the resource handle (reference core/handle.hpp) is a light ``Resources``
+  object carrying device, mesh and compile options — XLA owns scheduling;
+* host-boundary sequential work (dendrograms, label compaction, top-k
+  merge) runs in the native C++ extension (``raft_tpu.native``).
+
+Module map (reference dir → here): core→core, linalg→linalg, matrix→matrix,
+random→random, distance→distance, spatial/knn→spatial(+ann), cluster→cluster,
+sparse→sparse, spectral→spectral, stats→stats, label→label, lap→lap,
+cache→cache, comms→comms, pylibraft/pyraft→pylibraft(+comms).
 """
 
 from raft_tpu.core.resources import Resources, DeviceResources, get_default_resources
 from raft_tpu.core import logger
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Resources",
     "DeviceResources",
     "get_default_resources",
     "logger",
+    "cache",
+    "cluster",
+    "comms",
+    "distance",
+    "label",
+    "lap",
+    "linalg",
+    "matrix",
+    "pylibraft",
+    "random",
+    "sparse",
+    "spatial",
+    "spectral",
+    "stats",
+    "utils",
     "__version__",
 ]
+
+_SUBMODULES = {
+    "cache", "cluster", "comms", "core", "distance", "label", "lap",
+    "linalg", "matrix", "native", "pylibraft", "random", "sparse",
+    "spatial", "spectral", "stats", "utils",
+}
+
+
+def __getattr__(name):
+    # lazy submodule access so `import raft_tpu` stays light
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"raft_tpu.{name}")
+    raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")

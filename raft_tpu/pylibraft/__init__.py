@@ -1,0 +1,24 @@
+"""pylibraft-shaped facade — signature parity with the reference Python API
+(python/pylibraft/pylibraft/: common.Handle, distance.pairwise_distance;
+python/raft/raft/: Handle/Stream/interruptible — SURVEY.md §2 #44-45).
+
+Where pylibraft accepts any ``__cuda_array_interface__`` object and writes
+into a preallocated output, this facade accepts anything ``jnp.asarray``
+takes (numpy, jax.Array, buffers) and returns the result — functional, as
+the north star specifies ("pylibraft accepts jax.Array wherever it
+currently takes cupy").
+"""
+
+from raft_tpu.pylibraft.common import Handle, Stream, DeviceResources
+from raft_tpu.pylibraft import distance
+from raft_tpu.pylibraft import cluster
+from raft_tpu.pylibraft import neighbors
+
+__all__ = [
+    "Handle",
+    "Stream",
+    "DeviceResources",
+    "distance",
+    "cluster",
+    "neighbors",
+]

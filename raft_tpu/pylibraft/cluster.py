@@ -1,0 +1,36 @@
+"""pylibraft.cluster facade — kmeans entry points shaped like the
+reference's Python kmeans API (pylibraft 22.08 cluster.kmeans:
+compute_new_centroids etc.; the 22.06 tree exposes kmeans via C++ only,
+cpp/include/raft/cluster/kmeans.cuh:49).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.cluster import KMeansParams, kmeans_fit, kmeans_predict
+
+__all__ = ["fit", "predict", "cluster_cost", "KMeansParams"]
+
+
+def fit(X, n_clusters: int, max_iter: int = 300, tol: float = 1e-4,
+        seed: int = 0, handle=None):
+    """Returns (centroids, labels, inertia, n_iter)."""
+    out = kmeans_fit(
+        jnp.asarray(X),
+        KMeansParams(n_clusters=n_clusters, max_iter=max_iter, tol=tol,
+                     seed=seed),
+    )
+    return out.centroids, out.labels, out.inertia, out.n_iter
+
+
+def predict(X, centroids, handle=None):
+    return kmeans_predict(jnp.asarray(X), jnp.asarray(centroids))
+
+
+def cluster_cost(X, centroids, handle=None):
+    """Sum of squared distances to the nearest centroid."""
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+
+    minv, _ = fused_l2_nn(jnp.asarray(X), jnp.asarray(centroids))
+    return jnp.sum(minv)

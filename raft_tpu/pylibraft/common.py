@@ -1,0 +1,40 @@
+"""Handle/Stream facade — analog of pylibraft.common
+(python/pylibraft/pylibraft/common/handle.pyx Handle,
+common/cuda.pyx Stream; pyraft python/raft/raft/common/handle.pyx:30-60).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from raft_tpu.core.resources import Resources
+
+__all__ = ["Handle", "Stream", "DeviceResources"]
+
+
+class Stream:
+    """API-parity stream object (reference common/cuda.pyx). On TPU, XLA
+    owns scheduling; a Stream is a named token used only for interface
+    compatibility. ``sync()`` issues an effects barrier."""
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+
+    def sync(self) -> None:
+        jax.effects_barrier()
+
+
+class Handle(Resources):
+    """pyraft/pylibraft Handle (handle.pyx:30-60): a Resources subclass
+    with the n_streams constructor knob mapped to dispatch lanes."""
+
+    def __init__(self, n_streams: int = 0, device=None, mesh=None):
+        super().__init__(device=device, mesh=mesh, n_lanes=max(n_streams, 1))
+
+    def sync(self, *arrays) -> None:  # handle.sync() parity
+        super().sync(*arrays)
+
+
+DeviceResources = Handle

@@ -1,0 +1,42 @@
+"""pylibraft.distance facade — signature parity with
+python/pylibraft/pylibraft/distance/pairwise_distance.pyx:91-192
+(``distance(X, Y, dists, metric)``) and fused_l2_nn_argmin.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.distance import pairwise_distance as _pairwise
+from raft_tpu.distance import fused_l2_nn_argmin as _fused_argmin
+from raft_tpu.distance.distance_type import DISTANCE_NAMES
+
+#: metric names accepted by the reference pyx (pairwise_distance.pyx:35-60)
+SUPPORTED_DISTANCES = sorted(DISTANCE_NAMES)
+
+
+def pairwise_distance(X, Y, out=None, metric: str = "euclidean",
+                      p: float = 2.0, handle=None):
+    """Compute all-pairs distances (reference pairwise_distance.pyx:91).
+
+    ``out`` is accepted for signature parity; when given, the result is
+    also written into it via buffer protocol if possible (numpy arrays),
+    and always returned."""
+    d = _pairwise(jnp.asarray(X), jnp.asarray(Y), metric, p=p)
+    if out is not None:
+        import numpy as np
+
+        view = np.asarray(out)
+        if view.flags.writeable:
+            view[...] = np.asarray(d)
+    return d
+
+
+distance = pairwise_distance  # reference exposes both spellings
+
+
+def fused_l2_nn_argmin(X, Y, handle=None):
+    """Nearest-row index under L2 (pylibraft 22.08 fused_l2_nn_argmin)."""
+    return _fused_argmin(jnp.asarray(X), jnp.asarray(Y))

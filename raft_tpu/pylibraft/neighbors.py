@@ -1,0 +1,49 @@
+"""pylibraft.neighbors facade — brute-force + IVF search entry points
+shaped like the reference's Python neighbors API (pylibraft 22.10+
+neighbors.ivf_pq / brute_force; 22.06 exposes kNN through C++ and pyraft).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.spatial import brute_force_knn as _bfknn
+from raft_tpu.spatial.ann import (
+    IVFFlatParams, ivf_flat_build, ivf_flat_search,
+    IVFPQParams, ivf_pq_build, ivf_pq_search,
+)
+
+__all__ = ["brute_force", "ivf_flat", "ivf_pq"]
+
+
+class brute_force:
+    @staticmethod
+    def knn(dataset, queries, k: int, metric: str = "l2", handle=None):
+        return _bfknn(jnp.asarray(dataset), jnp.asarray(queries), k,
+                      metric=metric)
+
+
+class ivf_flat:
+    IndexParams = IVFFlatParams
+
+    @staticmethod
+    def build(dataset, params: IVFFlatParams = IVFFlatParams(), handle=None):
+        return ivf_flat_build(jnp.asarray(dataset), params)
+
+    @staticmethod
+    def search(index, queries, k: int, n_probes: int = 8, handle=None):
+        return ivf_flat_search(index, jnp.asarray(queries), k,
+                               n_probes=n_probes)
+
+
+class ivf_pq:
+    IndexParams = IVFPQParams
+
+    @staticmethod
+    def build(dataset, params: IVFPQParams = IVFPQParams(), handle=None):
+        return ivf_pq_build(jnp.asarray(dataset), params)
+
+    @staticmethod
+    def search(index, queries, k: int, n_probes: int = 8, handle=None):
+        return ivf_pq_search(index, jnp.asarray(queries), k,
+                             n_probes=n_probes)

@@ -76,37 +76,26 @@ def rbc_knn_query(
     can contain a closer neighbor — the reference's pruning criterion
     (detail/ball_cover.cuh perform_post_filter_registers) used here as a
     per-query certificate."""
+    from raft_tpu.spatial.ann.common import (
+        check_candidate_pool, coarse_probe, score_l2_candidates,
+        select_candidates,
+    )
+
     q = jnp.asarray(queries)
     nq, d = q.shape
     n_land = index.landmarks.shape[0]
     n_probes = min(n_probes, n_land)
-    if k > n_probes * index.storage.max_list:
-        raise ValueError("k exceeds candidate pool; raise n_probes")
-    f32 = jnp.float32
-    qf = q.astype(f32)
-    lm = index.landmarks.astype(f32)
+    check_candidate_pool(k, n_probes, index.storage)
+    qf = q.astype(jnp.float32)
 
-    qn = jnp.sum(qf * qf, axis=1)
-    ln = jnp.sum(lm * lm, axis=1)
-    g = lax.dot_general(qf, lm, (((1,), (1,)), ((), ())),
-                        preferred_element_type=f32)
-    ld = jnp.sqrt(jnp.maximum(qn[:, None] + ln[None, :] - 2.0 * g, 0.0))
-    neg, probes = lax.top_k(-ld, n_probes)                  # closest balls
+    probes, ld2 = coarse_probe(qf, index.landmarks, n_probes)
+    ld = jnp.sqrt(jnp.maximum(ld2, 0.0))  # true landmark distances for the bound
 
     cand_pos = index.storage.list_index[probes].reshape(nq, -1)
-    cand = index.data_sorted[cand_pos].astype(f32)
-    valid = cand_pos < index.storage.n
-    cvn = jnp.sum(cand * cand, axis=2)
-    dots = jnp.einsum("qcd,qd->qc", cand, qf, preferred_element_type=f32)
-    d2 = jnp.where(valid, qn[:, None] + cvn - 2.0 * dots, jnp.inf)
-
-    vals, pos = lax.top_k(-d2, k)
-    dists = jnp.sqrt(jnp.maximum(-vals, 0.0))
-    ids = index.storage.sorted_ids[
-        jnp.clip(jnp.take_along_axis(cand_pos, pos, axis=1), 0,
-                 index.storage.n - 1)
-    ]
-    ids = jnp.where(jnp.isfinite(-vals), ids, -1)
+    cand = index.data_sorted[cand_pos].astype(jnp.float32)
+    d2 = score_l2_candidates(qf, cand, cand_pos < index.storage.n)
+    vals, ids = select_candidates(index.storage, cand_pos, d2, k)
+    dists = jnp.sqrt(jnp.maximum(vals, 0.0))
 
     # exactness certificate: every UNPROBED ball satisfies
     # d(q, L) - radius_L >= kth  (probed balls were fully scored)

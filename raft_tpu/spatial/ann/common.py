@@ -40,6 +40,58 @@ class ListStorage:
     max_list: int = dataclasses.field(metadata=dict(static=True))
 
 
+def coarse_probe(qf, centroids, n_probes: int):
+    """Score queries against list centroids on the MXU and return the
+    ``n_probes`` closest lists per query.
+
+    Returns (probes (nq, p) int32, centroid_d2 (nq, n_lists) f32) — the
+    shared step (1)-(2) of every IVF-family search.
+    """
+    f32 = jnp.float32
+    cents = centroids.astype(f32)
+    qn = jnp.sum(qf * qf, axis=1)
+    cn = jnp.sum(cents * cents, axis=1)
+    g = jax.lax.dot_general(
+        qf, cents, (((1,), (1,)), ((), ())), preferred_element_type=f32
+    )
+    d2 = qn[:, None] + cn[None, :] - 2.0 * g
+    _, probes = jax.lax.top_k(-d2, n_probes)
+    return probes, d2
+
+
+def score_l2_candidates(qf, cand, valid):
+    """Batched |q - c|² over gathered candidates (nq, C, d), +inf where
+    ``valid`` is False — the shared step (4)."""
+    f32 = jnp.float32
+    qn = jnp.sum(qf * qf, axis=1)
+    cvn = jnp.sum(cand * cand, axis=2)
+    dots = jnp.einsum("qcd,qd->qc", cand, qf, preferred_element_type=f32)
+    return jnp.where(valid, qn[:, None] + cvn - 2.0 * dots, jnp.inf)
+
+
+def select_candidates(storage: ListStorage, cand_pos, d2, k: int):
+    """top-k over candidate scores + remap to original row ids (-1 for
+    padding that survives into the top-k) — the shared step (5)."""
+    vals, pos = jax.lax.top_k(-d2, k)
+    vals = -vals
+    ids = storage.sorted_ids[
+        jnp.clip(
+            jnp.take_along_axis(cand_pos, pos, axis=1), 0, storage.n - 1
+        )
+    ]
+    ids = jnp.where(jnp.isfinite(vals), ids, -1)
+    return vals, ids.astype(jnp.int32)
+
+
+def check_candidate_pool(k: int, n_probes: int, storage: ListStorage):
+    if k > n_probes * storage.max_list:
+        raise ValueError(
+            f"k={k} exceeds the candidate pool "
+            f"(n_probes*max_list = {n_probes * storage.max_list}); "
+            "raise n_probes"
+        )
+
+
 def build_list_storage(assignments, n_lists: int) -> ListStorage:
     """Host-side build (index construction is offline, like the reference's
     index build path)."""

@@ -72,48 +72,21 @@ def ivf_flat_search(
     """Search (reference approx_knn_search:169). Returns (dists, ids) with
     original row ids; L2 metric family (squared distances like FAISS's
     default compute, sqrt applied for metric='l2')."""
+    from raft_tpu.spatial.ann.common import (
+        check_candidate_pool, coarse_probe, score_l2_candidates,
+        select_candidates,
+    )
+
     q = jnp.asarray(queries)
     nq, d = q.shape
-    if k > n_probes * index.storage.max_list:
-        raise ValueError(
-            f"k={k} exceeds the candidate pool "
-            f"(n_probes*max_list = {n_probes * index.storage.max_list}); "
-            "raise n_probes"
-        )
-    f32 = jnp.float32
-    qf = q.astype(f32)
+    check_candidate_pool(k, n_probes, index.storage)
+    qf = q.astype(jnp.float32)
 
-    # (1) coarse scoring on the MXU
-    cents = index.centroids.astype(f32)
-    qn = jnp.sum(qf * qf, axis=1)
-    cn = jnp.sum(cents * cents, axis=1)
-    gc = lax.dot_general(qf, cents, (((1,), (1,)), ((), ())),
-                         preferred_element_type=f32)
-    cd = qn[:, None] + cn[None, :] - 2.0 * gc
-    # (2) probe the nprobe closest lists
-    _, probes = lax.top_k(-cd, n_probes)                    # (nq, p)
-
-    # (3) rectangular gather of padded probed lists
-    cand_pos = index.storage.list_index[probes]             # (nq, p, L)
-    cand_pos = cand_pos.reshape(nq, -1)                     # (nq, C)
-    cand_vecs = index.data_sorted[cand_pos].astype(f32)     # (nq, C, d)
-    valid = cand_pos < index.storage.n
-
-    # (4) batched candidate scoring: d2 = |q|² + |c|² - 2 q·c
-    cvn = jnp.sum(cand_vecs * cand_vecs, axis=2)
-    dots = jnp.einsum("qcd,qd->qc", cand_vecs, qf,
-                      preferred_element_type=f32)
-    d2 = qn[:, None] + cvn - 2.0 * dots
-    d2 = jnp.where(valid, d2, jnp.inf)
-
-    # (5) select
-    vals, pos = lax.top_k(-d2, k)
-    vals = -vals
-    ids = index.storage.sorted_ids[
-        jnp.clip(jnp.take_along_axis(cand_pos, pos, axis=1), 0,
-                 index.storage.n - 1)
-    ]
-    ids = jnp.where(jnp.isfinite(vals), ids, -1)
+    probes, _ = coarse_probe(qf, index.centroids, n_probes)
+    cand_pos = index.storage.list_index[probes].reshape(nq, -1)
+    cand_vecs = index.data_sorted[cand_pos].astype(jnp.float32)
+    d2 = score_l2_candidates(qf, cand_vecs, cand_pos < index.storage.n)
+    vals, ids = select_candidates(index.storage, cand_pos, d2, k)
     if index.metric == "l2":
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))
-    return vals, ids.astype(jnp.int32)
+    return vals, ids

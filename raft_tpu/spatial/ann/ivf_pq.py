@@ -4,8 +4,9 @@ ann_quantized_faiss.cuh:115-206 + ``IVFPQParam`` ann_common.h; native here).
 
 Build: coarse k-means → per-list residuals → product quantization: the d
 dims split into M subspaces, each with its own 2^bits-entry codebook
-trained by k-means on residual sub-vectors (batched across subspaces with
-``vmap`` — M small k-means fits in one compiled program). Codes pack to
+trained by k-means on residual sub-vectors (a Python loop of M small
+k-means fits — M is single-digit-to-low-tens, and each fit reuses the
+jitted kmeans program across subspaces of equal shape). Codes pack to
 (n, M) uint8.
 
 Search (ADC — asymmetric distance computation): per (query, probed list) a
@@ -50,7 +51,6 @@ class IVFPQIndex:
     centroids: jax.Array      # (n_lists, d)
     codebooks: jax.Array      # (M, 2^bits, ds)
     codes_sorted: jax.Array   # (n + 1, M) uint8 — sentinel row appended
-    list_labels: jax.Array    # (n + 1,) int32 — coarse list of each row
     storage: ListStorage
     pq_dim: int = dataclasses.field(metadata=dict(static=True))
     pq_bits: int = dataclasses.field(metadata=dict(static=True))
@@ -62,6 +62,11 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     M = params.pq_dim
     if d % M != 0:
         raise ValueError(f"d={d} not divisible by pq_dim={M}")
+    if not 1 <= params.pq_bits <= 8:
+        raise ValueError(
+            f"pq_bits={params.pq_bits} out of range [1, 8] — codes are "
+            "stored as uint8"
+        )
     ds = d // M
     n_codes = 1 << params.pq_bits
 
@@ -85,7 +90,7 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
             KMeansParams(
                 n_clusters=min(n_codes, subx.shape[0]),
                 max_iter=params.pq_kmeans_n_iters,
-                seed=params.seed,
+                seed=seed,
             ),
         )
         cents = out.centroids
@@ -112,11 +117,8 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     codes_sorted = jnp.concatenate(
         [codes[storage.sorted_ids], jnp.zeros((1, M), jnp.uint8)]
     )
-    labels_sorted = jnp.concatenate(
-        [labels[storage.sorted_ids], jnp.zeros((1,), jnp.int32)]
-    )
     return IVFPQIndex(
-        coarse.centroids, codebooks, codes_sorted, labels_sorted, storage,
+        coarse.centroids, codebooks, codes_sorted, storage,
         M, params.pq_bits,
     )
 
@@ -126,23 +128,20 @@ def ivf_pq_search(
     index: IVFPQIndex, queries, k: int, *, n_probes: int = 8
 ) -> Tuple[jax.Array, jax.Array]:
     """ADC search; returns (approx squared L2 dists, original row ids)."""
+    from raft_tpu.spatial.ann.common import (
+        check_candidate_pool, coarse_probe, select_candidates,
+    )
+
     q = jnp.asarray(queries)
     nq, d = q.shape
     M = index.pq_dim
     ds = d // M
-    if k > n_probes * index.storage.max_list:
-        raise ValueError("k exceeds candidate pool; raise n_probes")
+    check_candidate_pool(k, n_probes, index.storage)
     f32 = jnp.float32
     qf = q.astype(f32)
     cents = index.centroids.astype(f32)
 
-    # coarse probe
-    qn = jnp.sum(qf * qf, axis=1)
-    cn = jnp.sum(cents * cents, axis=1)
-    gc = lax.dot_general(qf, cents, (((1,), (1,)), ((), ())),
-                         preferred_element_type=f32)
-    cd = qn[:, None] + cn[None, :] - 2.0 * gc
-    _, probes = lax.top_k(-cd, n_probes)                    # (nq, p)
+    probes, _ = coarse_probe(qf, cents, n_probes)           # (nq, p)
 
     # LUTs: residual of q wrt each probed centroid, per subspace vs codebook
     # (q, p, d) residuals -> (q, p, M, ds); codebooks (M, K, ds)
@@ -167,12 +166,4 @@ def ivf_pq_search(
     valid = cand_pos < index.storage.n
     d2 = jnp.where(valid, d2, jnp.inf).reshape(nq, -1)
     flat_pos = cand_pos.reshape(nq, -1)
-
-    vals, pos = lax.top_k(-d2, k)
-    vals = -vals
-    ids = index.storage.sorted_ids[
-        jnp.clip(jnp.take_along_axis(flat_pos, pos, axis=1), 0,
-                 index.storage.n - 1)
-    ]
-    ids = jnp.where(jnp.isfinite(vals), ids, -1)
-    return vals, ids.astype(jnp.int32)
+    return select_candidates(index.storage, flat_pos, d2, k)

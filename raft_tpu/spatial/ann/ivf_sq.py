@@ -68,34 +68,20 @@ def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
 def ivf_sq_search(
     index: IVFSQIndex, queries, k: int, *, n_probes: int = 8
 ) -> Tuple[jax.Array, jax.Array]:
+    from raft_tpu.spatial.ann.common import (
+        check_candidate_pool, coarse_probe, score_l2_candidates,
+        select_candidates,
+    )
+
     q = jnp.asarray(queries)
     nq, d = q.shape
-    if k > n_probes * index.storage.max_list:
-        raise ValueError("k exceeds candidate pool; raise n_probes")
-    f32 = jnp.float32
-    qf = q.astype(f32)
-    cents = index.centroids.astype(f32)
+    check_candidate_pool(k, n_probes, index.storage)
+    qf = q.astype(jnp.float32)
 
-    qn = jnp.sum(qf * qf, axis=1)
-    cn = jnp.sum(cents * cents, axis=1)
-    gc = lax.dot_general(qf, cents, (((1,), (1,)), ((), ())),
-                         preferred_element_type=f32)
-    _, probes = lax.top_k(-(qn[:, None] + cn[None, :] - 2.0 * gc), n_probes)
-
+    probes, _ = coarse_probe(qf, index.centroids, n_probes)
     cand_pos = index.storage.list_index[probes].reshape(nq, -1)
-    codes = index.codes_sorted[cand_pos].astype(f32)         # (q, C, d)
+    codes = index.codes_sorted[cand_pos].astype(jnp.float32)
+    # dequantization fused into candidate scoring
     cand = (codes + 128.0) * index.vscale[None, None, :] + index.vmin[None, None, :]
-    valid = cand_pos < index.storage.n
-
-    cvn = jnp.sum(cand * cand, axis=2)
-    dots = jnp.einsum("qcd,qd->qc", cand, qf, preferred_element_type=f32)
-    d2 = jnp.where(valid, qn[:, None] + cvn - 2.0 * dots, jnp.inf)
-
-    vals, pos = lax.top_k(-d2, k)
-    vals = -vals
-    ids = index.storage.sorted_ids[
-        jnp.clip(jnp.take_along_axis(cand_pos, pos, axis=1), 0,
-                 index.storage.n - 1)
-    ]
-    ids = jnp.where(jnp.isfinite(vals), ids, -1)
-    return vals, ids.astype(jnp.int32)
+    d2 = score_l2_candidates(qf, cand, cand_pos < index.storage.n)
+    return select_candidates(index.storage, cand_pos, d2, k)

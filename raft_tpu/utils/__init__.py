@@ -1,0 +1,15 @@
+"""Small host utilities — analog of the reference L0 helpers that are not
+CUDA-specific: raft/common/seive.hpp (prime sieve), pow2_utils.cuh,
+integer_utils.h.
+"""
+
+from raft_tpu.utils.seive import Seive
+from raft_tpu.utils.pow2 import Pow2, round_up_safe, round_down_safe, div_rounding_up
+
+__all__ = [
+    "Seive",
+    "Pow2",
+    "round_up_safe",
+    "round_down_safe",
+    "div_rounding_up",
+]

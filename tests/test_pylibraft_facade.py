@@ -1,0 +1,86 @@
+"""pylibraft-facade + utils tests (reference
+python/pylibraft/pylibraft/test/test_distance.py patterns)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.pylibraft import Handle, Stream, distance, cluster, neighbors
+from raft_tpu.utils import Seive, Pow2, round_up_safe, div_rounding_up
+
+
+def test_handle_stream():
+    h = Handle(n_streams=4)
+    assert h.n_lanes == 4
+    s = Stream("work")
+    s.sync()
+    h.sync()
+
+
+def test_pairwise_distance_facade(rng_np):
+    X = rng_np.standard_normal((20, 8)).astype(np.float32)
+    Y = rng_np.standard_normal((15, 8)).astype(np.float32)
+    out = np.zeros((20, 15), np.float32)
+    D = distance.pairwise_distance(X, Y, out, metric="euclidean")
+    want = np.sqrt(((X[:, None] - Y[None]) ** 2).sum(-1))
+    np.testing.assert_allclose(np.asarray(D), want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)  # written back
+
+
+def test_fused_argmin_facade(rng_np):
+    X = rng_np.standard_normal((12, 6)).astype(np.float32)
+    Y = rng_np.standard_normal((9, 6)).astype(np.float32)
+    idx = np.asarray(distance.fused_l2_nn_argmin(X, Y))
+    want = ((X[:, None] - Y[None]) ** 2).sum(-1).argmin(1)
+    np.testing.assert_array_equal(idx, want)
+
+
+def test_cluster_facade(rng_np):
+    from raft_tpu.random import make_blobs, RngState
+
+    X, _ = make_blobs(300, 6, n_clusters=3, cluster_std=0.3, state=RngState(2))
+    cents, labels, inertia, n_iter = cluster.fit(X, 3, seed=1)
+    assert cents.shape == (3, 6)
+    pred = np.asarray(cluster.predict(X, cents))
+    np.testing.assert_array_equal(pred, np.asarray(labels))
+    assert float(cluster.cluster_cost(X, cents)) == pytest.approx(
+        float(inertia), rel=1e-4
+    )
+
+
+def test_neighbors_facade(rng_np):
+    X = rng_np.standard_normal((500, 16)).astype(np.float32)
+    q = X[:10]
+    d, i = neighbors.brute_force.knn(X, q, 5)
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(10))
+    index = neighbors.ivf_flat.build(X, neighbors.ivf_flat.IndexParams(n_lists=8))
+    d2, i2 = neighbors.ivf_flat.search(index, q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(i2)[:, 0], np.arange(10))
+
+
+def test_seive():
+    s = Seive(100)
+    assert s.is_prime(97)
+    assert not s.is_prime(91)
+    np.testing.assert_array_equal(s.primes()[:5], [2, 3, 5, 7, 11])
+
+
+def test_pow2():
+    p = Pow2(16)
+    assert p.round_up(17) == 32
+    assert p.round_down(17) == 16
+    assert p.mod(19) == 3
+    assert p.div(32) == 2
+    assert p.is_aligned(48)
+    with pytest.raises(ValueError):
+        Pow2(12)
+    assert round_up_safe(10, 3) == 12
+    assert div_rounding_up(10, 3) == 4
+
+
+def test_lazy_submodules():
+    import raft_tpu
+
+    assert raft_tpu.stats.r2_score is not None
+    assert raft_tpu.lap.solve_lap is not None
+    with pytest.raises(AttributeError):
+        raft_tpu.nonexistent_module
