@@ -17,6 +17,7 @@ from raft_tpu.comms.comms import (
 from raft_tpu.comms import self_test
 from raft_tpu.comms.self_test import run_all_self_tests
 from raft_tpu.comms.mnmg import mnmg_knn, mnmg_kmeans_fit
+from raft_tpu.comms.ring import ring_knn, ring_pairwise_distance
 
 __all__ = [
     "AxisComms",
@@ -28,4 +29,6 @@ __all__ = [
     "run_all_self_tests",
     "mnmg_knn",
     "mnmg_kmeans_fit",
+    "ring_knn",
+    "ring_pairwise_distance",
 ]
