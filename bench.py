@@ -3,10 +3,10 @@
 Mirrors the reference's distance benchmark (cpp/bench/distance/distance_exp_l2.cu
 via the shared harness cpp/bench/distance/distance_common.cuh): time the
 expanded-L2 pairwise distance engine on a large square problem, using the
-shared loop-in-jit harness (bench/common.py — per-dispatch latency through
-the axon tunnel is ~10 ms, so host-side loops measure the tunnel, not the
-chip; a full-output reduce pins the dependence so XLA cannot narrow the
-measured computation).
+shared loop-in-jit harness (bench/common.py — two-point difference timing
+cancels the ~100 ms fixed dispatch+fetch cost of the axon tunnel; a
+full-output reduce pins the dependence so XLA cannot narrow the measured
+computation).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -42,7 +42,7 @@ def main():
     with contextlib.redirect_stdout(io.StringIO()):  # suppress harness line
         ms = bench_fn(
             lambda a, b: _expanded_impl(DistanceType.L2Expanded, a, b, "default"),
-            x, y, iters=20, name="headline",
+            x, y, iters=40, name="headline",
         )
 
     gflops = 2.0 * m * n * d / (ms / 1e3) / 1e9

@@ -1,8 +1,15 @@
 """Benchmark harness — analog of cpp/bench/common/benchmark.hpp
-(fixture + cuda_event_timer). TPU methodology: the repeat loop lives inside
-ONE jit (lax.fori_loop) because per-dispatch latency through the axon
-tunnel (~10 ms) would otherwise dominate; a full-output reduce pins the
-dependence so XLA cannot dead-code or narrow the measured computation.
+(fixture + cuda_event_timer). TPU methodology:
+
+1. the repeat loop lives inside ONE jit (lax.fori_loop) — per-dispatch
+   latency through the axon tunnel would otherwise dominate;
+2. the iteration count is a RUNTIME argument and the reported time is the
+   two-point difference (t(n2) - t(n1)) / (n2 - n1), which cancels the
+   ~100 ms fixed cost of a synchronous dispatch+fetch through the tunnel
+   (measured: a trivial 20-iter and 400-iter loop both take ~103 ms total);
+3. float inputs are perturbed by i*0 so XLA cannot hoist the body out of
+   the loop, and every output element feeds a reduce so XLA cannot narrow
+   the computation.
 """
 
 from __future__ import annotations
@@ -16,16 +23,24 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def bench_fn(make_fn: Callable, *args, iters: int = 20, name: str = "",
+def _median_of(f, reps: int = 5) -> float:
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def bench_fn(make_fn: Callable, *args, iters: int = 40, name: str = "",
              work: float = 0.0, unit: str = "GFLOPS"):
-    """Time ``make_fn(*args)`` inside a fori_loop; returns ms/iter and
-    prints one JSON line {name, ms, value, unit}."""
+    """Time ``make_fn(*args)``; returns ms/iter and prints one JSON line
+    {name, ms_per_iter, value?, unit?}."""
 
     @jax.jit
-    def loop(*a):
+    def loop(n, *a):
         def body(i, acc):
-            # perturb float inputs by i*0 so XLA cannot hoist the whole
-            # computation out of the loop as loop-invariant
             def bump(x):
                 if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
                     return x + jnp.asarray(i, x.dtype) * jnp.asarray(0, x.dtype)
@@ -38,17 +53,21 @@ def bench_fn(make_fn: Callable, *args, iters: int = 20, name: str = "",
                 if hasattr(l, "astype")
             ]
             return acc + sum(leaves)
-        return lax.fori_loop(0, iters, body, jnp.float32(0.0))
+        return lax.fori_loop(0, n, body, jnp.float32(0.0))
 
-    loop(*args).block_until_ready()  # compile
-    # best-of-3: the first timed run per process pays a large one-time
-    # runtime warmup through the axon tunnel
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(loop(*args))
-        best = min(best, time.perf_counter() - t0)
-    ms = best / iters * 1e3
+    n0 = max(iters // 8, 1)
+    float(loop(n0, *args))  # compile (n is a runtime arg: one program)
+    t0 = _median_of(lambda: float(loop(n0, *args)), reps=3)
+    # pilot to size n2 so the compute delta dominates the ~10-30 ms jitter
+    # of the fixed dispatch cost; n1 = n2/4 keeps both points in the same
+    # jitter regime and median-of-5 resists asymmetric outliers
+    t_pilot = _median_of(lambda: float(loop(4 * n0, *args)), reps=1)
+    per_iter_est = max((t_pilot - t0) / (3 * n0), 1e-6)
+    n2 = int(min(max(iters, 1.0 / per_iter_est), 20_000))
+    n1 = max(n2 // 4, 1)
+    t1 = _median_of(lambda: float(loop(n1, *args)))
+    t2 = _median_of(lambda: float(loop(n2, *args)))
+    ms = max(t2 - t1, 1e-9) / (n2 - n1) * 1e3
     rec = {"name": name, "ms_per_iter": round(ms, 4)}
     if work:
         rec["value"] = round(work / (ms / 1e3) / 1e9, 2)
