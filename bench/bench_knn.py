@@ -19,15 +19,20 @@ def main():
     for n, d, nq, k in [(100_000, 128, 1024, 10), (1_000_000, 96, 256, 10)]:
         index = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
         q = jax.device_put(rng.standard_normal((nq, d)).astype(np.float32))
-        ms = bench_fn(
-            lambda a, b: _knn_single_part(
-                a, b, k, DistanceType.L2SqrtExpanded, 2.0, 65536, None
-            )[0],
-            q, index,
-            name=f"knn/bf_search/{n}x{d}q{nq}k{k}", iters=5,
-            work=2.0 * n * d * nq,
-        )
-        print(f'{{"name": "knn/qps/{n}x{d}", "qps": {round(nq / (ms / 1e3))}}}')
+        for mode, exact in [("exact", True), ("approx", False)]:
+            ms = bench_fn(
+                lambda a, b: _knn_single_part(
+                    a, b, k, DistanceType.L2SqrtExpanded, 2.0, 65536, None,
+                    exact,
+                )[0],
+                q, index,
+                name=f"knn/bf_{mode}/{n}x{d}q{nq}k{k}", iters=5,
+                work=2.0 * n * d * nq,
+            )
+            print(
+                f'{{"name": "knn/qps_{mode}/{n}x{d}", '
+                f'"qps": {round(nq / (ms / 1e3))}}}'
+            )
 
     # k-selection algos (selection.cu)
     dists = jax.device_put(rng.standard_normal((4096, 16384)).astype(np.float32))

