@@ -15,21 +15,33 @@ def main():
     n, d, k = 1_000_000, 128, 1024
     x = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
 
-    iters = 5
-    out = kmeans_fit(x, KMeansParams(n_clusters=k, max_iter=2, seed=0))
-    jax.block_until_ready(out.centroids)  # compile + init
+    # methodology: two programs (max_iter=5 vs 20, tol=0 so the bound binds)
+    # timed on FRESH input values — the axon runtime memoizes executions
+    # with identical inputs, so warmup runs use different data; the
+    # iteration cost is the difference quotient, cancelling k-means++ init
+    # (present in both runs).
+    p5 = KMeansParams(n_clusters=k, max_iter=5, tol=0.0, seed=0)
+    p20 = KMeansParams(n_clusters=k, max_iter=20, tol=0.0, seed=0)
+    float(kmeans_fit(x, p5).inertia)   # compile p5 (scalar fetch: block_until_ready does not block through the axon tunnel)
+    float(kmeans_fit(x, p20).inertia)  # compile p20
+
+    import jax.numpy as jnp
+
+    x2 = jax.block_until_ready(x * jnp.float32(1.0001))  # fresh values
     t0 = time.perf_counter()
-    out = kmeans_fit(
-        x, KMeansParams(n_clusters=k, max_iter=iters, tol=0.0, seed=0)
-    )
-    jax.block_until_ready(out.centroids)
-    dt = time.perf_counter() - t0
-    per_iter = dt / max(int(out.n_iter), 1)
+    out5 = kmeans_fit(x2, p5)
+    float(out5.inertia)
+    t5 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out20 = kmeans_fit(x2, p20)
+    float(out20.inertia)
+    t20 = time.perf_counter() - t0
+    per_iter = (t20 - t5) / (int(out20.n_iter) - int(out5.n_iter))
     print(json.dumps({
         "name": f"kmeans/{n}x{d}k{k}",
-        "s_per_iter": round(per_iter, 3),
+        "s_per_iter": round(per_iter, 4),
         "iters_per_s": round(1.0 / per_iter, 3),
-        "n_iter": int(out.n_iter),
+        "init_plus_fixed_s": round(t5 - 5 * per_iter, 3),
     }))
 
 

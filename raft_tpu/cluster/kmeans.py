@@ -126,7 +126,10 @@ def _lloyd(x, cents0, k: int, max_iter: int, tol: float, block_rows: int):
     m, d = x.shape
 
     def assign(cents):
-        minv, mini = fused_l2_nn(x, cents)
+        # default MXU precision (bf16 passes, f32 accumulate): ~5x the
+        # HIGHEST-precision gram; borderline mis-assignments are benign in
+        # lloyd iterations (and vanish as centroids converge)
+        minv, mini = fused_l2_nn(x, cents, precision="default")
         return mini, jnp.sum(minv)
 
     def reseed_empty(cents, counts, key):

@@ -83,6 +83,25 @@ def select_candidates(storage: ListStorage, cand_pos, d2, k: int):
     return vals, ids.astype(jnp.int32)
 
 
+def map_query_blocks(fn, queries, block_q: int):
+    """Process queries in fixed-size blocks via ``lax.map`` so the
+    (block, n_probes·max_list, d) candidate gather stays HBM-bounded
+    regardless of batch size. ``fn(q_block) -> (vals, ids)``."""
+    nq = queries.shape[0]
+    if block_q >= nq:
+        return fn(queries)
+    nb = -(-nq // block_q)
+    pad = nb * block_q - nq
+    qp = jnp.pad(queries, ((0, pad),) + ((0, 0),) * (queries.ndim - 1))
+    vals, ids = jax.lax.map(
+        fn, qp.reshape(nb, block_q, *queries.shape[1:])
+    )
+    return (
+        vals.reshape(nb * block_q, -1)[:nq],
+        ids.reshape(nb * block_q, -1)[:nq],
+    )
+
+
 def check_candidate_pool(k: int, n_probes: int, storage: ListStorage):
     if k > n_probes * storage.max_list:
         raise ValueError(

@@ -65,28 +65,32 @@ def ivf_flat_build(x, params: IVFFlatParams = IVFFlatParams(), *,
     return IVFFlatIndex(out.centroids, data_sorted, storage, metric)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes"))
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))
 def ivf_flat_search(
-    index: IVFFlatIndex, queries, k: int, *, n_probes: int = 8
+    index: IVFFlatIndex, queries, k: int, *, n_probes: int = 8,
+    block_q: int = 512,
 ) -> Tuple[jax.Array, jax.Array]:
     """Search (reference approx_knn_search:169). Returns (dists, ids) with
     original row ids; L2 metric family (squared distances like FAISS's
-    default compute, sqrt applied for metric='l2')."""
+    default compute, sqrt applied for metric='l2'). Query batches are
+    processed in ``block_q`` blocks to bound the candidate-gather HBM."""
     from raft_tpu.spatial.ann.common import (
-        check_candidate_pool, coarse_probe, score_l2_candidates,
-        select_candidates,
+        check_candidate_pool, coarse_probe, map_query_blocks,
+        score_l2_candidates, select_candidates,
     )
 
     q = jnp.asarray(queries)
-    nq, d = q.shape
     check_candidate_pool(k, n_probes, index.storage)
-    qf = q.astype(jnp.float32)
 
-    probes, _ = coarse_probe(qf, index.centroids, n_probes)
-    cand_pos = index.storage.list_index[probes].reshape(nq, -1)
-    cand_vecs = index.data_sorted[cand_pos].astype(jnp.float32)
-    d2 = score_l2_candidates(qf, cand_vecs, cand_pos < index.storage.n)
-    vals, ids = select_candidates(index.storage, cand_pos, d2, k)
+    def one_block(qb):
+        qf = qb.astype(jnp.float32)
+        probes, _ = coarse_probe(qf, index.centroids, n_probes)
+        cand_pos = index.storage.list_index[probes].reshape(qb.shape[0], -1)
+        cand_vecs = index.data_sorted[cand_pos].astype(jnp.float32)
+        d2 = score_l2_candidates(qf, cand_vecs, cand_pos < index.storage.n)
+        return select_candidates(index.storage, cand_pos, d2, k)
+
+    vals, ids = map_query_blocks(one_block, q, block_q)
     if index.metric == "l2":
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))
     return vals, ids

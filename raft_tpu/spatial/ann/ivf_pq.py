@@ -123,47 +123,54 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes"))
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))
 def ivf_pq_search(
-    index: IVFPQIndex, queries, k: int, *, n_probes: int = 8
+    index: IVFPQIndex, queries, k: int, *, n_probes: int = 8,
+    block_q: int = 256,
 ) -> Tuple[jax.Array, jax.Array]:
-    """ADC search; returns (approx squared L2 dists, original row ids)."""
+    """ADC search; returns (approx squared L2 dists, original row ids).
+    Query batches run in ``block_q`` blocks so the per-(query, list) LUTs
+    and the (q, p, L, M) code gather stay HBM-bounded."""
     from raft_tpu.spatial.ann.common import (
-        check_candidate_pool, coarse_probe, select_candidates,
+        check_candidate_pool, coarse_probe, map_query_blocks,
+        select_candidates,
     )
 
     q = jnp.asarray(queries)
-    nq, d = q.shape
+    d = q.shape[1]
     M = index.pq_dim
     ds = d // M
     check_candidate_pool(k, n_probes, index.storage)
     f32 = jnp.float32
-    qf = q.astype(f32)
     cents = index.centroids.astype(f32)
-
-    probes, _ = coarse_probe(qf, cents, n_probes)           # (nq, p)
-
-    # LUTs: residual of q wrt each probed centroid, per subspace vs codebook
-    # (q, p, d) residuals -> (q, p, M, ds); codebooks (M, K, ds)
-    res = qf[:, None, :] - cents[probes]                    # (q, p, d)
-    res = res.reshape(nq, n_probes, M, ds)
     cb = jnp.where(jnp.isfinite(index.codebooks), index.codebooks, 0.0)
     cb_n = jnp.sum(cb * cb, axis=2)                          # (M, K)
-    dots = jnp.einsum("qpmd,mkd->qpmk", res, cb,
-                      preferred_element_type=f32)
-    res_n = jnp.sum(res * res, axis=3)                       # (q, p, M)
-    lut = res_n[..., None] + cb_n[None, None] - 2.0 * dots   # (q, p, M, K)
 
-    # candidates: padded probed lists, gather codes, sum LUT entries
-    cand_pos = index.storage.list_index[probes]              # (q, p, L)
-    L = index.storage.max_list
-    codes = index.codes_sorted[cand_pos].astype(jnp.int32)   # (q, p, L, M)
-    # dist[q,p,l] = sum_m lut[q,p,m,codes[q,p,l,m]]
-    lut_t = lut.transpose(0, 1, 3, 2)                        # (q, p, K, M)
-    gath = jnp.take_along_axis(lut_t, codes, axis=2)         # (q, p, L, M)
-    d2 = jnp.sum(gath, axis=3)                               # (q, p, L)
+    def one_block(qb):
+        nq = qb.shape[0]
+        qf = qb.astype(f32)
+        probes, _ = coarse_probe(qf, cents, n_probes)        # (q, p)
 
-    valid = cand_pos < index.storage.n
-    d2 = jnp.where(valid, d2, jnp.inf).reshape(nq, -1)
-    flat_pos = cand_pos.reshape(nq, -1)
-    return select_candidates(index.storage, flat_pos, d2, k)
+        # LUTs: residual of q wrt each probed centroid, per subspace vs
+        # codebook; (q, p, d) residuals -> (q, p, M, ds)
+        res = qf[:, None, :] - cents[probes]
+        res = res.reshape(nq, n_probes, M, ds)
+        dots = jnp.einsum("qpmd,mkd->qpmk", res, cb,
+                          preferred_element_type=f32)
+        res_n = jnp.sum(res * res, axis=3)                   # (q, p, M)
+        lut = res_n[..., None] + cb_n[None, None] - 2.0 * dots  # (q,p,M,K)
+
+        # candidates: padded probed lists, gather codes, sum LUT entries
+        cand_pos = index.storage.list_index[probes]          # (q, p, L)
+        codes = index.codes_sorted[cand_pos].astype(jnp.int32)  # (q,p,L,M)
+        # dist[q,p,l] = sum_m lut[q,p,m,codes[q,p,l,m]]
+        lut_t = lut.transpose(0, 1, 3, 2)                    # (q, p, K, M)
+        gath = jnp.take_along_axis(lut_t, codes, axis=2)     # (q, p, L, M)
+        d2 = jnp.sum(gath, axis=3)                           # (q, p, L)
+
+        valid = cand_pos < index.storage.n
+        d2 = jnp.where(valid, d2, jnp.inf).reshape(nq, -1)
+        flat_pos = cand_pos.reshape(nq, -1)
+        return select_candidates(index.storage, flat_pos, d2, k)
+
+    return map_query_blocks(one_block, q, block_q)

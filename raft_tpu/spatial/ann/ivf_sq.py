@@ -64,24 +64,30 @@ def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
     return IVFSQIndex(out.centroids, codes_sorted, vmin, vscale, storage)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes"))
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))
 def ivf_sq_search(
-    index: IVFSQIndex, queries, k: int, *, n_probes: int = 8
+    index: IVFSQIndex, queries, k: int, *, n_probes: int = 8,
+    block_q: int = 512,
 ) -> Tuple[jax.Array, jax.Array]:
     from raft_tpu.spatial.ann.common import (
-        check_candidate_pool, coarse_probe, score_l2_candidates,
-        select_candidates,
+        check_candidate_pool, coarse_probe, map_query_blocks,
+        score_l2_candidates, select_candidates,
     )
 
     q = jnp.asarray(queries)
-    nq, d = q.shape
     check_candidate_pool(k, n_probes, index.storage)
-    qf = q.astype(jnp.float32)
 
-    probes, _ = coarse_probe(qf, index.centroids, n_probes)
-    cand_pos = index.storage.list_index[probes].reshape(nq, -1)
-    codes = index.codes_sorted[cand_pos].astype(jnp.float32)
-    # dequantization fused into candidate scoring
-    cand = (codes + 128.0) * index.vscale[None, None, :] + index.vmin[None, None, :]
-    d2 = score_l2_candidates(qf, cand, cand_pos < index.storage.n)
-    return select_candidates(index.storage, cand_pos, d2, k)
+    def one_block(qb):
+        qf = qb.astype(jnp.float32)
+        probes, _ = coarse_probe(qf, index.centroids, n_probes)
+        cand_pos = index.storage.list_index[probes].reshape(qb.shape[0], -1)
+        codes = index.codes_sorted[cand_pos].astype(jnp.float32)
+        # dequantization fused into candidate scoring
+        cand = (
+            (codes + 128.0) * index.vscale[None, None, :]
+            + index.vmin[None, None, :]
+        )
+        d2 = score_l2_candidates(qf, cand, cand_pos < index.storage.n)
+        return select_candidates(index.storage, cand_pos, d2, k)
+
+    return map_query_blocks(one_block, q, block_q)
