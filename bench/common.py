@@ -65,6 +65,7 @@ def bench_fn(make_fn: Callable, *args, iters: int = 40, name: str = "",
     per_iter_est = max((t_pilot - t0) / (3 * n0), 1e-6)
     n2 = int(min(max(iters, 1.0 / per_iter_est), 20_000))
     n1 = max(n2 // 4, 1)
+    n2 = max(n2, n1 + 1)  # slow workloads can pilot to n2 == n1 == 1
     t1 = _median_of(lambda: float(loop(n1, *args)))
     t2 = _median_of(lambda: float(loop(n2, *args)))
     ms = max(t2 - t1, 1e-9) / (n2 - n1) * 1e3

@@ -65,12 +65,14 @@ def _auction_round(benefits, state: _AuctionState) -> _AuctionState:
     # (previous owners are implicitly evicted — row_to_col is rebuilt from
     # the authoritative col_to_row below)
     new_col_to_row = jnp.where(got_bid, winner, state.col_to_row)
-    # rows: evicted rows lose their column; winners gain theirs
-    row_to_col = jnp.full((n,), -1, jnp.int32)
+    # rows: evicted rows lose their column; winners gain theirs. Unassigned
+    # columns scatter to the out-of-bounds index n, which JAX drops — a
+    # dummy write to index 0 would race with row 0's real assignment
+    # (duplicate-index .set order is undefined).
     valid_cols = new_col_to_row >= 0
-    row_to_col = row_to_col.at[jnp.where(valid_cols, new_col_to_row, 0)].set(
-        jnp.where(valid_cols, jnp.arange(n, dtype=jnp.int32), -1)
-    )
+    row_to_col = jnp.full((n,), -1, jnp.int32).at[
+        jnp.where(valid_cols, new_col_to_row, n)
+    ].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
     prices = jnp.where(got_bid, state.prices + col_bid, state.prices)
     return _AuctionState(row_to_col, new_col_to_row, prices, state.eps)
 

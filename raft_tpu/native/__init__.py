@@ -11,31 +11,56 @@ binary can be produced, and callers fall back to numpy paths.
 from __future__ import annotations
 
 import ctypes
+import glob
+import hashlib
 import os
 import subprocess
-import sysconfig
+import tempfile
 
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "host_algos.cpp")
-_LIB = os.path.join(_HERE, "libraft_tpu_host.so")
 
 
-def _build() -> None:
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-o", _LIB, _SRC,
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
+def _lib_path() -> str:
+    # Cache keyed by source hash: a binary built from different source never
+    # loads (mtimes are unreliable after git checkout), and the binary itself
+    # is never version-controlled.
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_HERE, f"libraft_tpu_host-{digest}.so")
+
+
+def _build(lib: str) -> None:
+    # Build to a temp file then atomically rename, so a crashed/concurrent
+    # build never leaves a half-written .so at the cache path.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+        )
+        os.chmod(tmp, 0o755)  # mkstemp's 0600 would break shared installs
+        os.replace(tmp, lib)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    for stale in glob.glob(os.path.join(_HERE, "libraft_tpu_host-*.so")):
+        if stale != lib:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
 
 
 def _load() -> ctypes.CDLL:
-    if (not os.path.exists(_LIB)) or (
-        os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-    ):
-        _build()
-    return ctypes.CDLL(_LIB)
+    lib = _lib_path()
+    if not os.path.exists(lib):
+        _build(lib)
+    return ctypes.CDLL(lib)
 
 
 try:

@@ -165,6 +165,11 @@ def brute_force_knn(
     queries = jnp.asarray(queries)
     parts = index if isinstance(index, (list, tuple)) else [index]
     parts = [jnp.asarray(pt) for pt in parts]
+    total_rows = sum(pt.shape[0] for pt in parts)
+    if k > total_rows:
+        raise ValueError(
+            f"k={k} exceeds total index size {total_rows}"
+        )
 
     if translations is None:
         offs, acc = [], 0
