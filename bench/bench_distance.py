@@ -9,7 +9,6 @@ from bench.common import bench_fn
 from raft_tpu.distance.pairwise import _expanded_impl, _unexpanded_impl
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.distance_type import DistanceType
-from raft_tpu.distance.pallas_kernels import pallas_pairwise
 
 
 def main():
@@ -31,11 +30,6 @@ def main():
             bench_fn(
                 lambda a, b: _unexpanded_impl(DistanceType.L1, a, b, 2.0, None),
                 x, y, name=f"distance/l1_xla/{m}x{n}x{d}", work=m * n * d,
-                unit="Gop/s",
-            )
-            bench_fn(
-                lambda a, b: pallas_pairwise(a, b, DistanceType.L1),
-                x, y, name=f"distance/l1_pallas/{m}x{n}x{d}", work=m * n * d,
                 unit="Gop/s",
             )
         bench_fn(

@@ -11,8 +11,9 @@ Architecture is TPU-first, not a CUDA translation:
 
 * matmul-shaped work (expanded distances, kmeans update, PQ scoring, cov,
   contingency) rides the MXU via ``lax.dot_general`` with f32 accumulation;
-* non-GEMM metrics use XLA broadcast-reduce fusion or tiled Pallas VPU
-  kernels (``raft_tpu.distance.pallas_pairwise``);
+* non-GEMM metrics use XLA broadcast-reduce fusion; the hand-tiled Pallas
+  engine lives where tiling beats XLA — the fused distance+select kNN
+  kernel (``raft_tpu.spatial.fused_knn``);
 * irregular algorithms (MST, union-merge, auction LAP) are segment-scatter
   + pointer-jumping formulations, not thread-divergent ports;
 * sparse data lives in static-capacity padded COO/CSR pytrees; sparse

@@ -1,8 +1,10 @@
 """Pairwise distance layer — analog of raft/distance (reference
 cpp/include/raft/distance/, ~6.4 kLoC CUDA; see SURVEY.md §2 #12-15).
 
-MXU-ridden expanded metrics + Pallas-tiled VPU unexpanded metrics + fused
-L2 1-NN. Public surface mirrors ``raft::distance``.
+MXU-ridden expanded metrics + XLA broadcast-reduce fused VPU unexpanded
+metrics + fused L2 1-NN. Public surface mirrors ``raft::distance``. The
+hand-tiled Pallas engine lives in :mod:`raft_tpu.spatial.fused_knn`, where
+tiling beats XLA (fused distance+select).
 """
 
 from raft_tpu.distance.distance_type import (
@@ -19,7 +21,6 @@ from raft_tpu.distance.pairwise import (
     row_norm_sq,
 )
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn, fused_l2_nn_argmin
-from raft_tpu.distance.pallas_kernels import pallas_pairwise
 
 __all__ = [
     "DistanceType",
@@ -33,5 +34,4 @@ __all__ = [
     "row_norm_sq",
     "fused_l2_nn",
     "fused_l2_nn_argmin",
-    "pallas_pairwise",
 ]

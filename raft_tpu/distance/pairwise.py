@@ -12,12 +12,11 @@ Design (TPU-first, not a translation):
   ``euclideanAlgo1``), but expressed so XLA fuses the epilogue into the
   matmul's output.
 * **Unexpanded metrics** (L1/Linf/Canberra/Lp/Hamming/JS/KL/BrayCurtis/
-  L2Unexpanded) are **VPU** work: an accumulate-over-features loop. Two
-  paths: an XLA broadcast-reduce (compiler-fused; good on CPU and for small
-  shapes) and a tiled Pallas kernel (``pallas_pairwise`` in
-  :mod:`raft_tpu.distance.pallas_kernels`) that mirrors the reference's
-  2D-tile engine (detail/pairwise_distance_base.cuh:122-226) with VMEM tiles
-  instead of shared memory.
+  L2Unexpanded) are **VPU** work: an accumulate-over-features loop expressed
+  as an XLA broadcast-reduce, which the compiler fuses so (m, n, d) never
+  materialises. (A hand-tiled Pallas variant measured slower than this
+  fusion at every shape tried and was removed; the winning tiled engine is
+  the fused distance+select kernel in :mod:`raft_tpu.spatial.fused_knn`.)
 * ``fin_op`` is fused into the epilogue exactly like the reference's fused
   final op (pairwise_distance_base.cuh epilog), so e.g. epsilon-neighborhood
   thresholding never materialises the raw distance matrix.
@@ -304,8 +303,13 @@ def pairwise_distance(
     (reference distance.cuh:417-450) with ``fin_op`` fused like the kernel's
     final op (pairwise_distance_base.cuh epilog).
 
-    method: "auto" | "xla" | "pallas" — pallas selects the tiled VPU kernel
-    for unexpanded metrics on TPU backends.
+    method: "auto" | "xla" (kept for API stability). A hand-tiled Pallas
+    path for unexpanded metrics existed through round 1 but measured slower
+    than XLA's broadcast-reduce fusion at every shape tried (the broadcast
+    is a fusion root into the reduction — (m,n,d) never materializes), so
+    it was removed; the winning hand-tiled engine lives where tiling beats
+    XLA: the fused distance+select kernel
+    (:mod:`raft_tpu.spatial.fused_knn`).
 
     Note: ``fin_op`` is a static (trace-time) argument — pass a *stable*
     callable (module-level function or cached lambda); a fresh lambda per
@@ -322,16 +326,7 @@ def pairwise_distance(
     elif metric in EXPANDED_METRICS:
         out = _expanded_impl(metric, x, y, precision)
     else:
-        # measured on v5e: XLA's broadcast-reduce fusion currently beats the
-        # pallas tile kernel for VPU metrics (it never materialises (m,n,d) —
-        # the broadcast is a fusion root into the reduction), so "auto" stays
-        # on the XLA path; pallas remains opt-in while it is tuned.
-        if method == "pallas":
-            from raft_tpu.distance.pallas_kernels import pallas_pairwise
-
-            out = pallas_pairwise(x, y, metric, p=p)
-        else:
-            out = _unexpanded_impl(metric, x, y, p, block_m)
+        out = _unexpanded_impl(metric, x, y, p, block_m)
 
     if fin_op is not None:
         out = fin_op(out)

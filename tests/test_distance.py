@@ -11,7 +11,6 @@ from raft_tpu.distance import (
     fused_l2_nn,
     fused_l2_nn_argmin,
     haversine_distance,
-    pallas_pairwise,
 )
 
 
@@ -182,41 +181,6 @@ def test_blocked_matches_unblocked(rng_np):
     a = np.asarray(pairwise_distance(x, y, DistanceType.L1))
     b = np.asarray(pairwise_distance(x, y, DistanceType.L1, block_m=16))
     np.testing.assert_allclose(a, b, rtol=1e-6)
-
-
-PALLAS_METRICS = [
-    DistanceType.L1,
-    DistanceType.L2Unexpanded,
-    DistanceType.L2SqrtUnexpanded,
-    DistanceType.Linf,
-    DistanceType.Canberra,
-    DistanceType.LpUnexpanded,
-    DistanceType.HammingUnexpanded,
-    DistanceType.BrayCurtis,
-]
-
-
-@pytest.mark.parametrize("metric", PALLAS_METRICS)
-def test_pallas_pairwise(metric, rng_np):
-    # interpret mode on CPU; ragged shapes exercise the padding path
-    m, n, d = 19, 35, 13
-    x = rng_np.standard_normal((m, d)).astype(np.float32)
-    y = rng_np.standard_normal((n, d)).astype(np.float32)
-    got = np.asarray(pallas_pairwise(x, y, metric, p=3.0, bm=8, bn=128, bk=4))
-    want = naive_pairwise(x, y, metric, p=3.0)
-    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
-
-
-def test_pallas_prob_metrics(rng_np):
-    m, n, d = 16, 20, 8
-    x = rng_np.random((m, d)).astype(np.float32) + 0.01
-    y = rng_np.random((n, d)).astype(np.float32) + 0.01
-    x /= x.sum(1, keepdims=True)
-    y /= y.sum(1, keepdims=True)
-    for metric in (DistanceType.KLDivergence, DistanceType.JensenShannon):
-        got = np.asarray(pallas_pairwise(x, y, metric, bm=8, bn=128, bk=4))
-        want = naive_pairwise(x, y, metric)
-        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 # ---------------------------------------------------------------------------
