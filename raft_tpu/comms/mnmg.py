@@ -168,6 +168,30 @@ def mnmg_kmeans_fit(
             minv = jnp.where(valid, minv, 0.0)
             return mini, ax.allreduce(jnp.sum(minv))
 
+        def reseed_empty(cents, counts):
+            # global reseed matching the single-device path (reference
+            # detail/kmeans.cuh:882-896): empty centroids jump onto the
+            # globally farthest points. Each rank contributes its local
+            # top-k farthest rows; an allgather builds the global pool and
+            # every rank picks the same winners (deterministic).
+            minv, _ = fused_l2_nn(x_loc, cents)
+            minv = jnp.where(valid, minv, -jnp.inf)
+            kk = min(k, x_loc.shape[0])
+            lv, li = lax.top_k(minv, kk)
+            cand = x_loc[li]                          # (kk, d)
+            all_v = ax.allgather(lv, tiled=True)      # (P*kk,)
+            all_c = ax.allgather(cand, tiled=True)    # (P*kk, d)
+            far = jnp.argsort(-all_v)
+            empty_rank = jnp.cumsum(counts == 0) - 1
+            take = jnp.where(
+                counts == 0,
+                far[jnp.clip(empty_rank, 0, all_v.shape[0] - 1)],
+                0,
+            )
+            return jnp.where(
+                (counts == 0)[:, None], all_c[take].astype(cents.dtype), cents
+            )
+
         def step(state):
             it, cents, _, res, labels = state
             labels, _ = assign(cents)
@@ -180,9 +204,7 @@ def mnmg_kmeans_fit(
             new_cents = (sums / jnp.maximum(counts, 1.0)[:, None]).astype(
                 x_loc.dtype
             )
-            # empty clusters keep their previous position (global reseed
-            # needs a global argmax; cheap fallback matching tolerance)
-            new_cents = jnp.where((counts == 0)[:, None], cents, new_cents)
+            new_cents = reseed_empty(new_cents, counts)
             _, new_res = assign(new_cents)
             return it + 1, new_cents, res, new_res, labels
 

@@ -10,6 +10,7 @@ from raft_tpu.spatial.ann.ivf_flat import (
     IVFFlatIndex,
     ivf_flat_build,
     ivf_flat_search,
+    ivf_flat_search_grouped,
 )
 from raft_tpu.spatial.ann.ivf_pq import (
     IVFPQParams,
@@ -33,6 +34,7 @@ from raft_tpu.spatial.ann.ball_cover import (
 __all__ = [
     "ListStorage", "build_list_storage",
     "IVFFlatParams", "IVFFlatIndex", "ivf_flat_build", "ivf_flat_search",
+    "ivf_flat_search_grouped",
     "IVFPQParams", "IVFPQIndex", "ivf_pq_build", "ivf_pq_search",
     "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
     "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",

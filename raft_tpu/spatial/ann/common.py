@@ -61,11 +61,16 @@ def coarse_probe(qf, centroids, n_probes: int):
 
 def score_l2_candidates(qf, cand, valid):
     """Batched |q - c|² over gathered candidates (nq, C, d), +inf where
-    ``valid`` is False — the shared step (4)."""
+    ``valid`` is False — the shared step (4). HIGHEST precision: this is
+    the *exact* scoring primitive (refinement, final IVF distances), so
+    operands must not be rounded by the default matmul precision."""
     f32 = jnp.float32
     qn = jnp.sum(qf * qf, axis=1)
     cvn = jnp.sum(cand * cand, axis=2)
-    dots = jnp.einsum("qcd,qd->qc", cand, qf, preferred_element_type=f32)
+    dots = jnp.einsum(
+        "qcd,qd->qc", cand, qf, preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
     return jnp.where(valid, qn[:, None] + cvn - 2.0 * dots, jnp.inf)
 
 

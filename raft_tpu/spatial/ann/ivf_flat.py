@@ -24,7 +24,13 @@ from jax import lax
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
 
-__all__ = ["IVFFlatParams", "IVFFlatIndex", "ivf_flat_build", "ivf_flat_search"]
+__all__ = [
+    "IVFFlatParams",
+    "IVFFlatIndex",
+    "ivf_flat_build",
+    "ivf_flat_search",
+    "ivf_flat_search_grouped",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,6 +40,7 @@ class IVFFlatParams:
     n_lists: int = 64
     kmeans_n_iters: int = 20
     seed: int = 0
+    kmeans_init: str = "k-means++"  # "random": cheap coarse quantizer
 
 
 @jax.tree_util.register_dataclass
@@ -56,6 +63,7 @@ def ivf_flat_build(x, params: IVFFlatParams = IVFFlatParams(), *,
             n_clusters=params.n_lists,
             max_iter=params.kmeans_n_iters,
             seed=params.seed,
+            init=params.kmeans_init,
         ),
     )
     storage = build_list_storage(np.asarray(out.labels), params.n_lists)
@@ -94,3 +102,133 @@ def ivf_flat_search(
     if index.metric == "l2":
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))
     return vals, ids
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "qcap", "list_block"),
+)
+def _grouped_impl(index, q, k, n_probes, qcap, list_block):
+    storage = index.storage
+    n_lists = storage.list_index.shape[0]
+    L = storage.max_list
+    nq, d = q.shape
+    p = n_probes
+    f32 = jnp.float32
+    qf = q.astype(f32)
+
+    from raft_tpu.spatial.ann.common import coarse_probe
+
+    probes, _ = coarse_probe(qf, index.centroids, p)         # (nq, p)
+
+    # invert the probe map: for each list, the (padded) set of queries
+    # probing it. Pairs sorted by list id; position within the group is the
+    # query's slot in that list's row.
+    l_flat = probes.reshape(-1)                              # (nq*p,)
+    q_flat = jnp.repeat(jnp.arange(nq, dtype=jnp.int32), p)
+    order = jnp.argsort(l_flat, stable=True)
+    sl = l_flat[order]
+    sq = q_flat[order]
+    starts = jnp.searchsorted(sl, jnp.arange(n_lists, dtype=sl.dtype))
+    slot_sorted = (
+        jnp.arange(nq * p, dtype=jnp.int32) - starts[sl].astype(jnp.int32)
+    )
+    qmat = jnp.full((n_lists, qcap), nq, jnp.int32).at[
+        sl, slot_sorted
+    ].set(sq, mode="drop")                                   # (n_lists, qcap)
+    # slot of each pair in ORIGINAL (query-major) order, for result gather
+    slot = jnp.zeros((nq * p,), jnp.int32).at[order].set(slot_sorted)
+
+    q_pad = jnp.concatenate([qf, jnp.zeros((1, d), f32)])    # sentinel query
+    qn_pad = jnp.concatenate(
+        [jnp.sum(qf * qf, axis=1), jnp.zeros((1,), f32)]
+    )
+
+    def block_fn(lblk):                                      # (LB,) list ids
+        qids = qmat[lblk]                                    # (LB, qcap)
+        qv = q_pad[qids]                                     # (LB, qcap, d)
+        qnv = qn_pad[qids]                                   # (LB, qcap)
+        mpos = storage.list_index[lblk]                      # (LB, L)
+        mv = index.data_sorted[mpos].astype(f32)             # (LB, L, d)
+        mn = jnp.sum(mv * mv, axis=2)                        # (LB, L)
+        dots = jnp.einsum(
+            "bqd,bld->bql", qv, mv, preferred_element_type=f32,
+            precision=lax.Precision.HIGHEST,
+        )  # MXU batched; HIGHEST keeps f32 operands un-rounded so grouped
+        #    scores match the per-query path bit-for-near (measured: DEFAULT
+        #    rounds operands and perturbs ~1e-3 of neighbor orderings)
+        d2 = qnv[:, :, None] + mn[:, None, :] - 2.0 * dots
+        invalid = (qids >= nq)[:, :, None] | (mpos >= storage.n)[:, None, :]
+        d2 = jnp.where(invalid, jnp.inf, d2)
+        vals, sel = lax.top_k(-d2, k)                        # (LB, qcap, k)
+        memp = jnp.take_along_axis(
+            jnp.broadcast_to(mpos[:, None, :], d2.shape), sel, axis=2
+        )
+        return -vals, memp
+
+    lids = jnp.arange(n_lists, dtype=jnp.int32).reshape(-1, list_block)
+    vals, mem = lax.map(block_fn, lids)
+    vals = vals.reshape(n_lists, qcap, k)
+    mem = mem.reshape(n_lists, qcap, k)
+
+    # per-pair result gather (original query-major order), then final k
+    ok = slot < qcap
+    safe_slot = jnp.minimum(slot, qcap - 1)
+    pv = jnp.where(ok[:, None], vals[l_flat, safe_slot], jnp.inf)
+    pm = mem[l_flat, safe_slot]
+    pv = pv.reshape(nq, p * k)
+    pm = pm.reshape(nq, p * k)
+    fvals, fpos = lax.top_k(-pv, k)
+    fmem = jnp.take_along_axis(pm, fpos, axis=1)
+    ids = storage.sorted_ids[jnp.clip(fmem, 0, storage.n - 1)]
+    ids = jnp.where(jnp.isfinite(-fvals), ids, -1).astype(jnp.int32)
+    return -fvals, ids
+
+
+def ivf_flat_search_grouped(
+    index: IVFFlatIndex, queries, k: int, *, n_probes: int = 8,
+    qcap: Optional[int] = None, list_block: int = 32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Throughput-mode IVF search, grouped by LIST instead of by query —
+    the query-side "sorted-by-list batching" (SURVEY.md §7 hard part №3).
+
+    ``ivf_flat_search`` gathers each probing query's lists independently,
+    so a list's vectors are re-read once per probing query — random gathers
+    dominate at large batch and dense brute force wins. Here the probe map
+    is inverted: one sweep over lists, each list's vectors loaded ONCE per
+    batch and scored against all its (padded, ``qcap``-capped) probing
+    queries with a batched MXU contraction; per-(list, query) top-k results
+    are then redistributed pair-wise and reduced per query. Compute is
+    ~n_probes/n_lists of brute force while traffic stays one dataset sweep.
+
+    ``qcap`` caps queries per list (static shape); lists probed by more
+    than ``qcap`` queries drop the overflow (tiny recall cost, reported by
+    the bench). Default: 2x the mean occupancy, 8-aligned.
+
+    Exactness: with ``qcap`` large enough this returns exactly what
+    ``ivf_flat_search`` returns for the same ``n_probes`` (tested).
+    """
+    q = jnp.asarray(queries)
+    nq = q.shape[0]
+    storage = index.storage
+    if k > storage.max_list:
+        # a single list cannot fill a per-list top-k row
+        return ivf_flat_search(index, q, k, n_probes=n_probes)
+    check = k <= n_probes * storage.max_list
+    if not check:
+        raise ValueError("k exceeds candidate pool; raise n_probes")
+    n_lists = storage.list_index.shape[0]
+    if qcap is None:
+        mean_occ = max(1, (nq * n_probes + n_lists - 1) // n_lists)
+        qcap = min(nq, _round_up8(2 * mean_occ))
+    list_block = max(1, min(list_block, n_lists))
+    while n_lists % list_block:
+        list_block -= 1
+    vals, ids = _grouped_impl(index, q, k, n_probes, qcap, list_block)
+    if index.metric == "l2":
+        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+    return vals, ids
+
+
+def _round_up8(v: int) -> int:
+    return -(-v // 8) * 8

@@ -13,12 +13,20 @@ Search (ADC — asymmetric distance computation): per (query, probed list) a
 (M, 2^bits) lookup table of squared sub-distances between the query
 residual and every codebook entry — one batched MXU/VPU computation — then
 candidate scores are M gathered-LUT sums, and ``lax.top_k`` selects.
-"""
+
+Refinement (``refine_ratio`` > 1, the FAISS IndexRefineFlat niche the
+reference's FAISS build exposes downstream): the index keeps the raw
+vectors in list-sorted order; search takes the top ``refine_ratio * k``
+ADC candidates, rescores them with exact f32 L2 (a c ≪ n gather + MXU
+batched dot), and re-selects k — recovering near-exact recall at PQ
+speed."""
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import math
+import typing
 from typing import Tuple
 
 import jax
@@ -43,6 +51,8 @@ class IVFPQParams:
     kmeans_n_iters: int = 20
     pq_kmeans_n_iters: int = 20
     seed: int = 0
+    store_raw: bool = True    # keep raw vectors for exact refinement
+    kmeans_init: str = "k-means++"  # "random": cheap coarse/code books
 
 
 @jax.tree_util.register_dataclass
@@ -52,6 +62,9 @@ class IVFPQIndex:
     codebooks: jax.Array      # (M, 2^bits, ds)
     codes_sorted: jax.Array   # (n + 1, M) uint8 — sentinel row appended
     storage: ListStorage
+    # (n + 1, d) raw vectors in list-sorted order (sentinel row appended),
+    # or None when built with store_raw=False (pure-PQ memory footprint)
+    vectors_sorted: typing.Optional[jax.Array]
     pq_dim: int = dataclasses.field(metadata=dict(static=True))
     pq_bits: int = dataclasses.field(metadata=dict(static=True))
 
@@ -76,6 +89,7 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
             n_clusters=params.n_lists,
             max_iter=params.kmeans_n_iters,
             seed=params.seed,
+            init=params.kmeans_init,
         ),
     )
     labels = coarse.labels
@@ -91,6 +105,7 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
                 n_clusters=min(n_codes, subx.shape[0]),
                 max_iter=params.pq_kmeans_n_iters,
                 seed=seed,
+                init=params.kmeans_init,
             ),
         )
         cents = out.centroids
@@ -117,23 +132,35 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     codes_sorted = jnp.concatenate(
         [codes[storage.sorted_ids], jnp.zeros((1, M), jnp.uint8)]
     )
+    vectors_sorted = None
+    if params.store_raw:
+        vectors_sorted = jnp.concatenate(
+            [x[storage.sorted_ids], jnp.zeros((1, d), x.dtype)]
+        )
     return IVFPQIndex(
-        coarse.centroids, codebooks, codes_sorted, storage,
+        coarse.centroids, codebooks, codes_sorted, storage, vectors_sorted,
         M, params.pq_bits,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_probes", "block_q", "refine_ratio")
+)
 def ivf_pq_search(
     index: IVFPQIndex, queries, k: int, *, n_probes: int = 8,
-    block_q: int = 256,
+    block_q: int = 256, refine_ratio: float = 2.0,
 ) -> Tuple[jax.Array, jax.Array]:
-    """ADC search; returns (approx squared L2 dists, original row ids).
+    """ADC search; returns (squared L2 dists, original row ids).
     Query batches run in ``block_q`` blocks so the per-(query, list) LUTs
-    and the (q, p, L, M) code gather stay HBM-bounded."""
+    and the (q, p, L, M) code gather stay HBM-bounded.
+
+    ``refine_ratio`` > 1 (and an index built with ``store_raw``) rescores
+    the top ``ceil(refine_ratio * k)`` ADC candidates with exact f32
+    distances before the final k-selection; returned distances are then
+    exact. ``refine_ratio <= 1`` returns raw ADC approximations."""
     from raft_tpu.spatial.ann.common import (
         check_candidate_pool, coarse_probe, map_query_blocks,
-        select_candidates,
+        score_l2_candidates, select_candidates,
     )
 
     q = jnp.asarray(queries)
@@ -141,6 +168,9 @@ def ivf_pq_search(
     M = index.pq_dim
     ds = d // M
     check_candidate_pool(k, n_probes, index.storage)
+    refine = index.vectors_sorted is not None and refine_ratio > 1.0
+    c = max(k, min(int(math.ceil(refine_ratio * k)),
+                   n_probes * index.storage.max_list))
     f32 = jnp.float32
     cents = index.centroids.astype(f32)
     cb = jnp.where(jnp.isfinite(index.codebooks), index.codebooks, 0.0)
@@ -171,6 +201,17 @@ def ivf_pq_search(
         valid = cand_pos < index.storage.n
         d2 = jnp.where(valid, d2, jnp.inf).reshape(nq, -1)
         flat_pos = cand_pos.reshape(nq, -1)
-        return select_candidates(index.storage, flat_pos, d2, k)
+
+        if not refine:
+            return select_candidates(index.storage, flat_pos, d2, k)
+
+        # refinement: top-c by ADC score, exact f32 rescore, re-select k
+        adc, cpos = jax.lax.top_k(-d2, c)                    # (q, c)
+        rpos = jnp.take_along_axis(flat_pos, cpos, axis=1)   # (q, c)
+        raw = index.vectors_sorted[rpos].astype(f32)         # (q, c, d)
+        exact = score_l2_candidates(
+            qf, raw, jnp.isfinite(-adc) & (rpos < index.storage.n)
+        )
+        return select_candidates(index.storage, rpos, exact, k)
 
     return map_query_blocks(one_block, q, block_q)

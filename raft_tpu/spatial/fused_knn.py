@@ -127,7 +127,10 @@ def _fused_l2_knn_impl(
     m, d = queries.shape
     n = index.shape[0]
     q = jnp.asarray(queries, jnp.float32)
-    y = jnp.asarray(index, jnp.float32)
+    # The index keeps its storage dtype (bf16 storage halves HBM for the
+    # 10M x 768 regime — no f32 copy is ever materialized; accumulations
+    # below are f32 via preferred_element_type).
+    y = jnp.asarray(index)
 
     npad = _round_up(n, bn)
     # Padded rows score +BIG in phase 1 (never win a chunk) and +BIG in
@@ -135,7 +138,7 @@ def _fused_l2_knn_impl(
     # NaNs out of the VPU.
     BIG = jnp.float32(1e30)
     yp = jnp.pad(y, ((0, npad - n), (0, 0)))
-    yn = jnp.sum(y * y, axis=-1)
+    yn = jnp.einsum("nd,nd->n", y, y, preferred_element_type=jnp.float32)
     ynp = jnp.pad(yn, (0, npad - n), constant_values=BIG)
 
     cmins = _chunk_mins(

@@ -8,7 +8,7 @@ import pytest
 
 from raft_tpu.spatial import brute_force_knn
 from raft_tpu.spatial.ann import (
-    ivf_flat_build, ivf_flat_search, IVFFlatParams,
+    ivf_flat_build, ivf_flat_search, ivf_flat_search_grouped, IVFFlatParams,
     ivf_pq_build, ivf_pq_search, IVFPQParams,
     ivf_sq_build, ivf_sq_search, IVFSQParams,
     rbc_build_index, rbc_knn_query, rbc_all_knn_query,
@@ -60,10 +60,60 @@ def test_ivf_flat_full_probe_exact(dataset):
 def test_ivf_pq_recall(dataset):
     x, q = dataset
     index = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=8, seed=0))
+    # refined search (default refine_ratio=2): near-exact recall
     d, i = ivf_pq_search(index, q, 10, n_probes=8)
+    bd, bi = brute_force_knn(x, q, 10, metric="l2")
+    r = recall(np.asarray(i), np.asarray(bi))
+    assert r > 0.9, r
+    # refined distances are exact squared L2 of the returned ids
+    row = np.linalg.norm(x[np.asarray(i)[0, 0]] - q[0]) ** 2
+    np.testing.assert_allclose(np.asarray(d)[0, 0], row, rtol=1e-3, atol=1e-3)
+
+
+def test_ivf_pq_unrefined_recall(dataset):
+    x, q = dataset
+    index = ivf_pq_build(
+        x, IVFPQParams(n_lists=16, pq_dim=8, seed=0, store_raw=False)
+    )
+    assert index.vectors_sorted is None
+    d, i = ivf_pq_search(index, q, 10, n_probes=8)  # no raw -> pure ADC
     _, bi = brute_force_knn(x, q, 10, metric="l2")
     r = recall(np.asarray(i), np.asarray(bi))
     assert r > 0.6, r  # quantized: lossy but far above chance (10/2000)
+
+
+def test_ivf_pq_refine_ratio_sweep(dataset):
+    """Recall must be monotone-ish in refine_ratio and hit >=0.95 at 4x."""
+    x, q = dataset
+    index = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=8, seed=0))
+    _, bi = brute_force_knn(x, q, 10, metric="l2")
+    r4 = recall(
+        np.asarray(ivf_pq_search(index, q, 10, n_probes=8, refine_ratio=4.0)[1]),
+        np.asarray(bi),
+    )
+    assert r4 >= 0.95, r4
+
+
+def test_ivf_flat_grouped_matches_per_query(dataset):
+    """List-major (query-grouped) search returns exactly the per-query
+    path's results when qcap can't truncate."""
+    x, q = dataset
+    index = ivf_flat_build(x, IVFFlatParams(n_lists=32, seed=0))
+    d1, i1 = ivf_flat_search(index, q, 10, n_probes=6)
+    d2, i2 = ivf_flat_search_grouped(index, q, 10, n_probes=6,
+                                     qcap=len(q))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    # values agree to f32 reduction-order noise (different matmul layouts)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                               rtol=2e-3, atol=1e-3)
+
+
+def test_ivf_flat_grouped_default_qcap_recall(dataset):
+    x, q = dataset
+    index = ivf_flat_build(x, IVFFlatParams(n_lists=32, seed=0))
+    _, i1 = ivf_flat_search(index, q, 10, n_probes=6)
+    _, i3 = ivf_flat_search_grouped(index, q, 10, n_probes=6)
+    assert recall(np.asarray(i3), np.asarray(i1)) > 0.95
 
 
 def test_ivf_pq_codes_shapes(dataset):
