@@ -23,7 +23,6 @@ import time
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 from bench.common import bench_fn
 from raft_tpu.spatial.ann import (

@@ -22,7 +22,9 @@ def main():
     # (present in both runs).
     p5 = KMeansParams(n_clusters=k, max_iter=5, tol=0.0, seed=0)
     p20 = KMeansParams(n_clusters=k, max_iter=20, tol=0.0, seed=0)
-    float(kmeans_fit(x, p5).inertia)   # compile p5 (scalar fetch: block_until_ready does not block through the axon tunnel)
+    # compile p5 (scalar fetch: block_until_ready does not block through
+    # the axon tunnel)
+    float(kmeans_fit(x, p5).inertia)
     float(kmeans_fit(x, p20).inertia)  # compile p20
 
     import jax.numpy as jnp

@@ -4,7 +4,6 @@ selection.cu (SelectKAlgo variants)."""
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 from bench.common import bench_fn
 from raft_tpu.distance.distance_type import DistanceType

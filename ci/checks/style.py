@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Self-contained style checker — the analog of the reference's custom check
+scripts (ci/checks/style.sh driving cpp/scripts/include_checker.py and
+friends). The build image ships no ruff/flake8 and installs are barred, so
+the checks that matter are implemented here directly; where ruff IS
+available (developer machines), `ruff check .` picks up the [tool.ruff]
+config in pyproject.toml and this script defers the overlap to it.
+
+Checks, per Python file:
+  * parses (syntax)
+  * no tabs in indentation, no trailing whitespace
+  * line length <= 100 (URLs in comments/docstrings exempt)
+  * module docstring present in library code (raft_tpu/)
+  * unused imports (AST pass; names referenced in __all__ count as used)
+
+Exit code 0 = clean. Run via ci/run.sh.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LINE = 100
+ROOT = Path(__file__).resolve().parents[2]
+
+CHECK_DIRS = ["raft_tpu", "tests", "bench", "ci"]
+CHECK_FILES = ["bench.py", "__graft_entry__.py"]
+
+
+def iter_py_files():
+    for d in CHECK_DIRS:
+        yield from sorted((ROOT / d).rglob("*.py"))
+    for f in CHECK_FILES:
+        p = ROOT / f
+        if p.exists():
+            yield p
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # attribute roots: walk down to the base Name
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+        elif isinstance(node, ast.Assign):
+            # names listed in __all__ literals count as used (re-exports)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    for el in ast.walk(node.value):
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                            used.add(el.value)
+    return used
+
+
+def check_file(path: Path) -> list[str]:
+    problems: list[str] = []
+    rel = path.relative_to(ROOT)
+    text = path.read_text()
+
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if line != line.rstrip():
+            problems.append(f"{rel}:{i}: trailing whitespace")
+        if "\t" in line[: len(line) - len(line.lstrip())]:
+            problems.append(f"{rel}:{i}: tab in indentation")
+        if len(line) > MAX_LINE and "http" not in line:
+            problems.append(f"{rel}:{i}: line too long ({len(line)} > {MAX_LINE})")
+
+    if str(rel).startswith("raft_tpu") and path.name != "__init__.py":
+        if not (tree.body and isinstance(tree.body[0], ast.Expr)
+                and isinstance(tree.body[0].value, ast.Constant)
+                and isinstance(tree.body[0].value.value, str)):
+            problems.append(f"{rel}:1: missing module docstring")
+
+    used = _used_names(tree)
+    init = path.name == "__init__.py"
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = (alias.asname or alias.name).split(".")[0]
+                if name not in used and not init:
+                    problems.append(
+                        f"{rel}:{node.lineno}: unused import '{alias.name}'"
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if name != "*" and name not in used and not init:
+                    problems.append(
+                        f"{rel}:{node.lineno}: unused import '{name}'"
+                    )
+    return problems
+
+
+def main() -> int:
+    all_problems: list[str] = []
+    n_files = 0
+    for path in iter_py_files():
+        n_files += 1
+        all_problems.extend(check_file(path))
+    for p in all_problems:
+        print(p)
+    print(f"style: checked {n_files} files, {len(all_problems)} problem(s)")
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ __all__ = [
     "DeviceResources",
     "get_default_resources",
     "logger",
+    "errors",
     "cache",
     "cluster",
     "comms",
@@ -61,7 +62,7 @@ __all__ = [
 ]
 
 _SUBMODULES = {
-    "cache", "cluster", "comms", "core", "distance", "label", "lap",
+    "cache", "cluster", "comms", "core", "distance", "errors", "label", "lap",
     "linalg", "matrix", "native", "pylibraft", "random", "sparse",
     "spatial", "spectral", "stats", "utils",
 }

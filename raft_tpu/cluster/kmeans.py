@@ -25,12 +25,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import errors
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 
 __all__ = [
@@ -175,6 +176,16 @@ def kmeans_fit(
     if params is None:
         params = KMeansParams(**kw)
     x = jnp.asarray(x)
+    errors.check_matrix(x, "x")
+    errors.check_k(params.n_clusters, x.shape[0], "n_clusters vs n rows")
+    errors.expects(params.max_iter >= 1, "max_iter must be >= 1, got %d", params.max_iter)
+    errors.expects(
+        centroids is None
+        or tuple(jnp.shape(centroids)) == (params.n_clusters, x.shape[1]),
+        "centroids: expected shape %s, got %s",
+        (params.n_clusters, x.shape[1]),
+        None if centroids is None else tuple(jnp.shape(centroids)),
+    )
     key = jax.random.PRNGKey(params.seed)
     if centroids is not None:
         cents0 = jnp.asarray(centroids, x.dtype)

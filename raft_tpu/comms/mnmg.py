@@ -14,7 +14,6 @@ run one ``shard_map`` so every collective rides ICI/DCN picked by XLA.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -24,10 +23,10 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu.cluster.kmeans import KMeansOutput, KMeansParams, _update_centroids
-from raft_tpu.comms.comms import AxisComms, Comms
+from raft_tpu.comms.comms import Comms
 from raft_tpu.distance.distance_type import resolve_metric
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
-from raft_tpu.spatial.knn import _knn_single_part, knn_merge_parts
+from raft_tpu.spatial.knn import _knn_single_part
 from raft_tpu.spatial.selection import select_k
 
 __all__ = ["mnmg_knn", "mnmg_kmeans_fit"]

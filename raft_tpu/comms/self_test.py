@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import AxisComms, Comms, ReduceOp
+from raft_tpu.comms.comms import Comms
 
 __all__ = [
     "test_collective_allreduce",

@@ -17,7 +17,7 @@ cancellation is cooperative, not preemptive).
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Optional
 
 import jax
 

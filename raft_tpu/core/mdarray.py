@@ -20,7 +20,7 @@ function), layout is carried as a plain argument where it matters.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp

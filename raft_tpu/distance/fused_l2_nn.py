@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import errors
+
 __all__ = ["fused_l2_nn", "fused_l2_nn_argmin"]
 
 
@@ -58,6 +60,9 @@ def fused_l2_nn(
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
+    errors.check_matrix(x, "x")
+    errors.check_matrix(y, "y")
+    errors.check_same_cols(x, y)
     if precision is None:
         precision = lax.Precision.HIGHEST
     m, d = x.shape

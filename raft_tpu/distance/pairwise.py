@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import errors
 from raft_tpu.distance.distance_type import (
     DistanceType,
     EXPANDED_METRICS,
@@ -317,9 +318,12 @@ def pairwise_distance(
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
-    if x.ndim != 2 or y.ndim != 2 or x.shape[1] != y.shape[1]:
-        raise ValueError(f"shape mismatch: {x.shape} vs {y.shape}")
+    errors.check_matrix(x, "x")
+    errors.check_matrix(y, "y")
+    errors.check_same_cols(x, y)
     metric = resolve_metric(metric)
+    if metric == DistanceType.LpUnexpanded:
+        errors.expects(p > 0, "LpUnexpanded needs p > 0, got %s", p)
 
     if metric == DistanceType.Haversine:
         out = haversine_distance(x, y)

@@ -1,0 +1,140 @@
+"""Error/validation layer — TPU-native analog of the reference exception
+machinery (cpp/include/raft/error.hpp:38-177: ``raft::exception`` with a
+collected backtrace, ``raft::logic_error``, and the ``RAFT_EXPECTS`` /
+``RAFT_FAIL`` macros).
+
+Design notes (Python/JAX, not a translation):
+
+* Python exceptions already carry tracebacks, so the reference's manual
+  ``backtrace(3)`` collection (error.hpp:57-103) maps to the interpreter's
+  native traceback; :class:`RaftException` adds the reference's
+  "RAFT failure at file:line" message framing by capturing the caller's
+  frame at raise time.
+* ``expects``/``fail`` are plain functions, usable inside jit-traced code
+  as long as the condition is a static Python bool (shape/dtype checks —
+  the overwhelming majority of ``RAFT_EXPECTS`` uses in the reference).
+  Value-dependent checks on traced arrays cannot raise at trace time; for
+  those, hosts call :func:`expect_finite` on concrete (numpy) inputs only.
+* Shared validators (:func:`check_matrix`, :func:`check_same_cols`,
+  :func:`check_k`) concentrate the shape/dtype contracts the reference
+  spreads across per-API ``RAFT_EXPECTS`` calls (e.g.
+  distance.cuh:417-426, knn.cuh:195-213).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "RaftException",
+    "RaftLogicError",
+    "expects",
+    "fail",
+    "check_matrix",
+    "check_same_cols",
+    "check_k",
+    "expect_finite",
+]
+
+
+class RaftException(RuntimeError):
+    """Analog of ``raft::exception`` (error.hpp:38-55): message prefixed
+    with the raise site, native traceback in place of the reference's
+    collected backtrace."""
+
+    def __init__(self, msg: str, *, _stacklevel: int = 1):
+        frame = inspect.stack()[_stacklevel]
+        super().__init__(
+            f"RAFT failure at {frame.filename}:{frame.lineno}: {msg}"
+        )
+
+
+class RaftLogicError(RaftException, ValueError):
+    """Analog of ``raft::logic_error`` (error.hpp:107): a precondition on
+    caller-supplied arguments failed. Subclasses ValueError so existing
+    ``except ValueError`` callers (and tests) keep working."""
+
+
+def expects(cond: Any, msg: str, *args: Any) -> None:
+    """``RAFT_EXPECTS(cond, fmt, ...)`` (error.hpp:151-158): raise
+    :class:`RaftLogicError` unless ``cond`` is truthy.
+
+    ``cond`` must be a static Python bool (shape/dtype predicates) — a
+    traced jax array is rejected, because a data-dependent branch cannot
+    raise at trace time.
+    """
+    if cond is True:
+        return
+    try:
+        ok = bool(cond)
+    except Exception as e:  # jax TracerBoolConversionError and kin
+        raise TypeError(
+            "expects() condition is a traced value; trace-time validation "
+            "must be shape/dtype-static (see expect_finite for "
+            "concrete-value checks)"
+        ) from e
+    if not ok:
+        raise RaftLogicError(msg % args if args else msg, _stacklevel=2)
+
+
+def fail(msg: str, *args: Any) -> None:
+    """``RAFT_FAIL(fmt, ...)`` (error.hpp:167-173): unconditional raise."""
+    raise RaftLogicError(msg % args if args else msg, _stacklevel=2)
+
+
+# ---------------------------------------------------------------------------
+# Shared validators for public entry points
+# ---------------------------------------------------------------------------
+
+_REAL_KINDS = ("f", "i", "u", "b")
+
+
+def check_matrix(x: Any, name: str, *, ndim: int = 2,
+                 min_rows: int = 1) -> None:
+    """Validate an array argument's rank, dtype kind, and non-degeneracy
+    (the per-API ``RAFT_EXPECTS`` shape block, e.g. distance.cuh:417-426)."""
+    shape = getattr(x, "shape", None)
+    expects(shape is not None, "%s: expected an array, got %s", name, type(x).__name__)
+    expects(
+        len(shape) == ndim,
+        "%s: expected a %dD array, got shape %s", name, ndim, shape,
+    )
+    dt = np.dtype(x.dtype)
+    expects(
+        dt.kind in _REAL_KINDS,
+        "%s: expected a real numeric dtype, got %s", name, dt,
+    )
+    expects(
+        shape[0] >= min_rows,
+        "%s: needs at least %d row(s), got shape %s", name, min_rows, shape,
+    )
+
+
+def check_same_cols(x: Any, y: Any, xname: str = "x", yname: str = "y") -> None:
+    """Both operands share the feature dimension (distance.cuh:420)."""
+    expects(
+        x.shape[-1] == y.shape[-1],
+        "%s/%s: feature dims differ (%d vs %d)",
+        xname, yname, x.shape[-1], y.shape[-1],
+    )
+
+
+def check_k(k: int, n: int, what: str = "index rows") -> None:
+    """1 <= k <= n (knn.cuh select_k/brute_force_knn contracts)."""
+    expects(isinstance(k, (int, np.integer)), "k must be an int, got %s", type(k).__name__)
+    expects(1 <= k <= n, "k=%d out of range [1, %d] (%s)", k, n, what)
+
+
+def expect_finite(x: Any, name: str = "input") -> None:
+    """All-finite check for CONCRETE (host) inputs; silently skipped for
+    traced values, where a value check cannot raise. Cheap relative to any
+    kernel that follows (one pass over host memory)."""
+    try:
+        arr = np.asarray(x)
+    except Exception:
+        return  # traced value: cannot inspect at trace time
+    if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+        fail("%s contains non-finite values (NaN/Inf)", name)

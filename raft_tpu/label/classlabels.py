@@ -10,7 +10,7 @@ here the capacity is an argument and the true count a returned scalar).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

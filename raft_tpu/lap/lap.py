@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import errors
+
 __all__ = ["solve_lap", "solve_lap_batched", "LinearAssignmentProblem"]
 
 
@@ -84,6 +86,11 @@ def solve_lap(cost, *, maximize: bool = False) -> Tuple[jax.Array, jax.Array]:
     (row assignments + dual-feasible prices internally).
     """
     cost = jnp.asarray(cost, jnp.float32)
+    errors.check_matrix(cost, "cost")
+    errors.expects(
+        cost.shape[0] == cost.shape[1],
+        "cost must be square, got %s", tuple(cost.shape),
+    )
     n = cost.shape[0]
     benefits = cost if maximize else -cost
     spread = jnp.maximum(jnp.max(benefits) - jnp.min(benefits), 1.0)

@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import errors
 from raft_tpu.linalg.gemm import gemm
 
 
@@ -34,7 +35,10 @@ def eig_dc(cov, n_eig_vals: Optional[int] = None) -> Tuple[jax.Array, jax.Array]
 
 def eig_jacobi(cov, tol: float = 1e-7, sweeps: int = 15):
     """Jacobi-method variant (reference eigJacobi). XLA's eigh is used; tol
-    and sweeps are accepted for API parity."""
+    and sweeps are accepted (and validated) for API parity only — eigh is
+    exact to machine precision, strictly tighter than any positive tol."""
+    errors.expects(tol > 0, "tol must be > 0, got %s", tol)
+    errors.expects(sweeps >= 1, "sweeps must be >= 1, got %s", sweeps)
     return eig_dc(cov)
 
 
@@ -87,7 +91,10 @@ def svd_eig(a):
 
 
 def svd_jacobi(a, tol: float = 1e-7, sweeps: int = 15):
-    """Jacobi variant (reference svdJacobi via gesvdj); delegates to XLA svd."""
+    """Jacobi variant (reference svdJacobi via gesvdj); delegates to XLA svd.
+    tol/sweeps validated for API parity (see :func:`eig_jacobi`)."""
+    errors.expects(tol > 0, "tol must be > 0, got %s", tol)
+    errors.expects(sweeps >= 1, "sweeps must be >= 1, got %s", sweeps)
     return svd_qr(a)
 
 

@@ -17,7 +17,6 @@ raft_tpu.sparse.linalg or a dense gemv — mirroring how the reference takes
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional, Tuple
 
 import jax

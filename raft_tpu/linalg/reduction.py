@@ -12,7 +12,7 @@ keys is small (MXU-friendly) and ``jax.ops.segment_sum`` otherwise.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -84,8 +84,9 @@ def reduce_rows_by_key(x, keys, n_keys: int, weights=None):
         x = x * jnp.asarray(weights)[:, None]
     if n_keys <= 4096:
         onehot = jax.nn.one_hot(keys, n_keys, dtype=x.dtype)
+        acc_t = jnp.promote_types(x.dtype, jnp.float32)
         return jnp.dot(onehot.T, x, precision="highest",
-                       preferred_element_type=jnp.promote_types(x.dtype, jnp.float32)).astype(x.dtype)
+                       preferred_element_type=acc_t).astype(x.dtype)
     return jax.ops.segment_sum(x, keys, num_segments=n_keys)
 
 

@@ -5,7 +5,6 @@ common/cuda.pyx Stream; pyraft python/raft/raft/common/handle.pyx:30-60).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 

@@ -5,7 +5,6 @@ python/pylibraft/pylibraft/distance/pairwise_distance.pyx:91-192
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax.numpy as jnp
 

@@ -12,6 +12,7 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import errors
 from raft_tpu.random.rng import RngState, _key_of
 
 
@@ -26,6 +27,9 @@ def make_blobs(n_samples: int, n_features: int, n_clusters: int = 5,
     ``center_box`` when not given; ``cluster_std`` scalar or per-cluster
     vector; samples assigned round-robin then shuffled.
     """
+    errors.expects(n_samples >= 1, "n_samples must be >= 1, got %d", n_samples)
+    errors.expects(n_features >= 1, "n_features must be >= 1, got %d", n_features)
+    errors.expects(n_clusters >= 1, "n_clusters must be >= 1, got %d", n_clusters)
     if state is None:
         state = RngState(0)
     key = _key_of(state)

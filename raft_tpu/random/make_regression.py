@@ -8,7 +8,7 @@ returns X, y and optionally the ground-truth coefficients).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ XLA cholesky (or eigh fallback for PSD-but-singular covariances) + MXU gemm.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

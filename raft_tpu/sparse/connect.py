@@ -12,7 +12,6 @@ op) — and emit the cross-component edges that stitch the graph together.
 
 from __future__ import annotations
 
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

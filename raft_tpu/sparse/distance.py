@@ -16,13 +16,12 @@ pairwise kernels, so every metric of the dense engine is available sparsely
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu.distance.distance_type import DistanceType, resolve_metric
+from raft_tpu.distance.distance_type import resolve_metric
 from raft_tpu.sparse.coo import CSR
 from raft_tpu.spatial.knn import _block_dist
 from raft_tpu.spatial.selection import merge_topk

@@ -13,18 +13,18 @@ when built (raft_tpu.native), else numpy union-find.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import errors
 from raft_tpu.sparse.coo import COO
 from raft_tpu.sparse.knn_graph import knn_graph
 from raft_tpu.sparse.mst import boruvka_mst
 from raft_tpu.sparse.connect import connect_components, get_n_components
-from raft_tpu.sparse.op import coo_sort, sum_duplicates
+from raft_tpu.sparse.op import sum_duplicates
 
 __all__ = [
     "LinkageResult",
@@ -182,7 +182,9 @@ def single_linkage(
     ``graph`` overrides the kNN graph (the reference's pairwise/"auto"
     distance-graph choice, LinkageDistance enum)."""
     x = jnp.asarray(x)
+    errors.check_matrix(x, "x", min_rows=2)
     n = x.shape[0]
+    errors.check_k(n_clusters, n, "n_clusters vs n rows")
     if graph is None:
         graph = knn_graph(x, min(k, n - 1), metric=metric)
     src, dst, w = build_sorted_mst(x, graph)

@@ -6,9 +6,7 @@ input to MST/single-linkage).
 
 from __future__ import annotations
 
-from typing import Optional
 
-import jax
 import jax.numpy as jnp
 
 from raft_tpu.sparse.coo import COO

@@ -10,9 +10,8 @@ stream compaction).
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable
 
-import jax
 import jax.numpy as jnp
 
 from raft_tpu.sparse.coo import COO, CSR

@@ -13,7 +13,6 @@ Probing gathers whole padded lists — rectangular, static-shape, MXU-friendly
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu import errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
 
@@ -57,6 +58,8 @@ def ivf_flat_build(x, params: IVFFlatParams = IVFFlatParams(), *,
     """Build (reference approx_knn_build_index:115 — FAISS train+add;
     here kmeans + list permutation)."""
     x = jnp.asarray(x)
+    errors.check_matrix(x, "x", min_rows=2)
+    errors.check_k(params.n_lists, x.shape[0], "n_lists vs dataset rows")
     out = kmeans_fit(
         x,
         KMeansParams(
@@ -88,6 +91,8 @@ def ivf_flat_search(
     )
 
     q = jnp.asarray(queries)
+    errors.check_matrix(q, "queries")
+    errors.check_same_cols(q, index.centroids, "queries", "index")
     check_candidate_pool(k, n_probes, index.storage)
 
     def one_block(qb):

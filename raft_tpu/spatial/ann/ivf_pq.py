@@ -32,8 +32,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
+from raft_tpu import errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit, kmeans_predict
 from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
 
@@ -71,15 +71,16 @@ class IVFPQIndex:
 
 def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     x = jnp.asarray(x)
+    errors.check_matrix(x, "x", min_rows=2)
     n, d = x.shape
     M = params.pq_dim
-    if d % M != 0:
-        raise ValueError(f"d={d} not divisible by pq_dim={M}")
-    if not 1 <= params.pq_bits <= 8:
-        raise ValueError(
-            f"pq_bits={params.pq_bits} out of range [1, 8] — codes are "
-            "stored as uint8"
-        )
+    errors.check_k(params.n_lists, n, "n_lists vs dataset rows")
+    errors.expects(d % M == 0, "d=%d not divisible by pq_dim=%d", d, M)
+    errors.expects(
+        1 <= params.pq_bits <= 8,
+        "pq_bits=%d out of range [1, 8] — codes are stored as uint8",
+        params.pq_bits,
+    )
     ds = d // M
     n_codes = 1 << params.pq_bits
 
@@ -164,6 +165,8 @@ def ivf_pq_search(
     )
 
     q = jnp.asarray(queries)
+    errors.check_matrix(q, "queries")
+    errors.check_same_cols(q, index.centroids, "queries", "index")
     d = q.shape[1]
     M = index.pq_dim
     ds = d // M

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import errors
 from raft_tpu.distance.distance_type import DistanceType, resolve_metric
 from raft_tpu.distance.pairwise import (
     _expanded_impl,
@@ -171,13 +172,20 @@ def brute_force_knn(
     """
     metric = resolve_metric(metric)
     queries = jnp.asarray(queries)
+    errors.check_matrix(queries, "queries")
     parts = index if isinstance(index, (list, tuple)) else [index]
+    errors.expects(len(parts) > 0, "index: need at least one partition")
     parts = [jnp.asarray(pt) for pt in parts]
+    for i, pt in enumerate(parts):
+        errors.check_matrix(pt, f"index[{i}]")
+        errors.check_same_cols(queries, pt, "queries", f"index[{i}]")
     total_rows = sum(pt.shape[0] for pt in parts)
-    if k > total_rows:
-        raise ValueError(
-            f"k={k} exceeds total index size {total_rows}"
-        )
+    errors.check_k(k, total_rows, "total index size")
+    errors.expects(
+        translations is None or len(translations) == len(parts),
+        "translations: %d offsets for %d partitions",
+        0 if translations is None else len(translations), len(parts),
+    )
 
     if translations is None:
         offs, acc = [], 0

@@ -15,11 +15,13 @@ from __future__ import annotations
 
 import enum
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from raft_tpu import errors
 
 __all__ = ["SelectKAlgo", "select_k", "select_k_blocked", "merge_topk"]
 
@@ -65,9 +67,14 @@ def select_k(
     ``raft::spatial::knn::select_k`` (knn.cuh:105-165).
     """
     dists = jnp.asarray(dists)
+    errors.check_matrix(dists, "dists")
     m, n = dists.shape
-    if k > n:
-        raise ValueError(f"k={k} > n={n}")
+    errors.check_k(k, n, "row length")
+    errors.expects(
+        indices is None or tuple(indices.shape) == (m, n),
+        "indices: expected shape %s, got %s",
+        (m, n), None if indices is None else tuple(indices.shape),
+    )
     algo = _resolve(algo, n, k)
 
     if algo == SelectKAlgo.SORT:

@@ -13,7 +13,7 @@ same with the modularity operator's LARGEST eigenvectors.
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,7 @@ one-hot matmul (MXU) — the reference's custom binning kernels
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -168,7 +168,6 @@ def batched_silhouette_score(
     """Chunked variant for large n (reference
     stats/detail/batched/silhouette_score.cuh): processes query batches
     against the full dataset so only (batch, n) tiles are live."""
-    import numpy as np
 
     x = jnp.asarray(x)
     labels = jnp.asarray(labels)

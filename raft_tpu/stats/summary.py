@@ -9,7 +9,7 @@ All are XLA reductions/matmuls; cov rides the MXU. Column-wise semantics
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,6 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.distance.pairwise import pairwise_distance
-from raft_tpu.spatial.knn import brute_force_knn
 
 __all__ = ["trustworthiness_score"]
 

@@ -1,0 +1,75 @@
+"""Worker entry for the real multi-process distributed test — the analog of
+a pyraft Dask worker in the reference's MNMG test
+(python/raft/raft/test/test_comms.py:200-336: every worker runs
+``perform_test_comms_*`` and the driver asserts all ranks return True).
+
+Invoked as: python multiproc_worker.py <coordinator> <num_procs> <rank>
+
+Forces the virtual CPU platform (2 local devices per process) and the gloo
+cross-process collectives backend BEFORE jax initializes, bootstraps the
+cluster via ``Comms.initialize_distributed`` (the Dask/NCCL-uniqueId
+rendezvous analog, reference comms.py:171-218 + nccl.pyx:52-57), then:
+
+  1. runs every communicator round-trip self-test (comms/detail/test.hpp
+     analog) on the 2x2-device global mesh;
+  2. fits a small distributed k-means on a shared deterministic dataset;
+
+and prints one JSON line with the results. The pytest driver
+(test_multiproc.py) spawns N of these and asserts cross-process agreement.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coordinator, num_procs, rank = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+
+    from raft_tpu.comms import Comms, build_comms, mnmg_kmeans_fit
+    from raft_tpu.comms.self_test import run_all_self_tests
+
+    Comms.initialize_distributed(coordinator, num_procs, rank)
+    assert jax.process_count() == num_procs
+
+    comms = build_comms()  # all global devices: num_procs x 2
+    self_tests = {k: bool(v) for k, v in run_all_self_tests(comms).items()}
+
+    # identical dataset on every rank (the reference's Dask test scatters
+    # from the client; here the shared seed plays that role)
+    rng = np.random.default_rng(7)
+    x = (
+        rng.standard_normal((512, 8)).astype(np.float32)
+        + 8.0 * rng.integers(0, 4, (512, 1)).astype(np.float32)
+    )
+    out = mnmg_kmeans_fit(comms, x, n_clusters=4, max_iter=20, seed=3)
+
+    print(json.dumps({
+        "rank": rank,
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "self_tests": self_tests,
+        "inertia": float(out.inertia),
+        "n_iter": int(out.n_iter),
+        "centroid_sum": float(np.asarray(out.centroids, np.float64).sum()),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

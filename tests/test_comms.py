@@ -9,14 +9,13 @@ import pytest
 import jax
 
 from raft_tpu.comms import (
-    Comms,
     build_comms,
     run_all_self_tests,
     mnmg_knn,
     mnmg_kmeans_fit,
 )
 from raft_tpu.comms import self_test as st
-from raft_tpu.cluster import KMeansParams, kmeans_fit
+from raft_tpu.cluster import KMeansParams
 from raft_tpu.spatial import brute_force_knn
 
 

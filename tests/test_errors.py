@@ -1,0 +1,184 @@
+"""Bad-input validation tests — the analog of the reference's
+``RAFT_EXPECTS`` contracts (cpp/include/raft/error.hpp:151-158) exercised
+at the top public entry points.
+
+Every raise is a RaftLogicError, which subclasses ValueError, so these
+assert ValueError throughout (the weaker, stable contract).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.lap.lap import solve_lap
+from raft_tpu.linalg.decomp import eig_jacobi, svd_jacobi
+from raft_tpu.random.make_blobs import make_blobs
+from raft_tpu.sparse.hierarchy import single_linkage
+from raft_tpu.spatial.ann.ivf_flat import IVFFlatParams, ivf_flat_build
+from raft_tpu.spatial.ann.ivf_pq import IVFPQParams, ivf_pq_build
+from raft_tpu.spatial.knn import brute_force_knn
+from raft_tpu.spatial.selection import select_k
+
+
+X = np.random.default_rng(0).standard_normal((20, 8)).astype(np.float32)
+
+
+# -- the primitive layer ----------------------------------------------------
+
+
+class TestExpects:
+    def test_pass(self):
+        errors.expects(True, "never")
+        errors.expects(1 == 1, "never")
+
+    def test_fail_message(self):
+        with pytest.raises(ValueError, match="RAFT failure at .*k=3 too big"):
+            errors.expects(False, "k=%d too big", 3)
+
+    def test_fail_is_raft_exception(self):
+        with pytest.raises(errors.RaftException):
+            errors.fail("boom")
+
+    def test_traced_condition_rejected(self):
+        @jax.jit
+        def f(x):
+            errors.expects(jnp.all(x > 0), "positive")
+            return x
+
+        with pytest.raises(TypeError, match="traced value"):
+            f(jnp.ones((3,)))
+
+    def test_expect_finite_host(self):
+        errors.expect_finite(np.ones(4), "ok")
+        with pytest.raises(ValueError, match="non-finite"):
+            errors.expect_finite(np.array([1.0, np.nan]), "bad")
+
+    def test_expect_finite_traced_noop(self):
+        @jax.jit
+        def f(x):
+            errors.expect_finite(x)  # silently skipped under trace
+            return x * 2
+
+        np.testing.assert_allclose(f(jnp.ones(2)), 2.0)
+
+
+# -- public entry points ----------------------------------------------------
+
+
+class TestPairwiseDistance:
+    def test_feature_mismatch(self):
+        with pytest.raises(ValueError, match="feature dims differ"):
+            pairwise_distance(X, X[:, :4])
+
+    def test_rank(self):
+        with pytest.raises(ValueError, match="2D"):
+            pairwise_distance(X[0], X)
+
+    def test_complex_dtype(self):
+        with pytest.raises(ValueError, match="dtype"):
+            pairwise_distance(X.astype(np.complex64), X.astype(np.complex64))
+
+    def test_bad_p(self):
+        with pytest.raises(ValueError, match="p > 0"):
+            pairwise_distance(X, X, "minkowski", p=0.0)
+
+
+class TestBruteForceKnn:
+    def test_k_too_big(self):
+        with pytest.raises(ValueError, match="out of range"):
+            brute_force_knn(X, X, k=21)
+
+    def test_k_zero(self):
+        with pytest.raises(ValueError, match="out of range"):
+            brute_force_knn(X, X, k=0)
+
+    def test_dim_mismatch(self):
+        with pytest.raises(ValueError, match="feature dims differ"):
+            brute_force_knn(X, X[:, :4], k=3)
+
+    def test_empty_partition_list(self):
+        with pytest.raises(ValueError, match="at least one partition"):
+            brute_force_knn([], X, k=1)
+
+    def test_translations_length(self):
+        with pytest.raises(ValueError, match="translations"):
+            brute_force_knn([X, X], X, k=3, translations=[0])
+
+
+class TestSelectK:
+    def test_k_too_big(self):
+        with pytest.raises(ValueError, match="out of range"):
+            select_k(X, k=9)
+
+    def test_indices_shape(self):
+        with pytest.raises(ValueError, match="indices"):
+            select_k(X, k=2, indices=jnp.zeros((3, 3), jnp.int32))
+
+
+class TestKmeans:
+    def test_too_many_clusters(self):
+        with pytest.raises(ValueError, match="out of range"):
+            kmeans_fit(X, KMeansParams(n_clusters=50))
+
+    def test_bad_max_iter(self):
+        with pytest.raises(ValueError, match="max_iter"):
+            kmeans_fit(X, KMeansParams(n_clusters=2, max_iter=0))
+
+    def test_centroid_shape(self):
+        with pytest.raises(ValueError, match="centroids"):
+            kmeans_fit(
+                X, KMeansParams(n_clusters=3), centroids=np.zeros((2, 8))
+            )
+
+
+class TestFusedL2NN:
+    def test_dim_mismatch(self):
+        with pytest.raises(ValueError, match="feature dims differ"):
+            fused_l2_nn(X, X[:, :4])
+
+
+class TestANN:
+    def test_ivf_flat_too_many_lists(self):
+        with pytest.raises(ValueError, match="out of range"):
+            ivf_flat_build(X, IVFFlatParams(n_lists=100))
+
+    def test_ivf_pq_indivisible(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            ivf_pq_build(X, IVFPQParams(n_lists=2, pq_dim=3))
+
+    def test_ivf_pq_bits(self):
+        with pytest.raises(ValueError, match="pq_bits"):
+            ivf_pq_build(X, IVFPQParams(n_lists=2, pq_dim=4, pq_bits=12))
+
+
+class TestLap:
+    def test_non_square(self):
+        with pytest.raises(ValueError, match="square"):
+            solve_lap(np.zeros((3, 4), np.float32))
+
+
+class TestLinkage:
+    def test_too_many_clusters(self):
+        with pytest.raises(ValueError, match="out of range"):
+            single_linkage(X, n_clusters=25)
+
+
+class TestMakeBlobs:
+    def test_zero_samples(self):
+        with pytest.raises(ValueError, match="n_samples"):
+            make_blobs(0, 4)
+
+
+class TestDecompParity:
+    def test_eig_jacobi_bad_tol(self):
+        with pytest.raises(ValueError, match="tol"):
+            eig_jacobi(np.eye(4, dtype=np.float32), tol=0.0)
+
+    def test_svd_jacobi_bad_sweeps(self):
+        with pytest.raises(ValueError, match="sweeps"):
+            svd_jacobi(X, sweeps=0)

@@ -3,7 +3,6 @@ reference test strategy (cpp/test/cluster/kmeans.cu: fit on blobs, check
 adjusted rand / score bounds)."""
 
 import numpy as np
-import pytest
 
 from raft_tpu.cluster import (
     KMeans,

@@ -14,7 +14,6 @@ from raft_tpu.spatial import (
     haversine_knn,
     epsilon_neighborhood,
 )
-from raft_tpu.distance import DistanceType
 
 
 def naive_knn(queries, index, k, metric="l2"):

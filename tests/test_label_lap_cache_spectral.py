@@ -7,7 +7,6 @@ import itertools
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
 
 from raft_tpu.label import (
     get_unique_labels,

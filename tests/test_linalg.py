@@ -1,6 +1,5 @@
 """linalg tests vs numpy oracles (analog of reference cpp/test/linalg/*)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

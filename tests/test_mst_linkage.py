@@ -3,16 +3,13 @@ invariant patterns (reference cpp/test/mst.cu, cpp/test/sparse/
 connect_components.cu, cpp/test/sparse/linkage.cu)."""
 
 import numpy as np
-import pytest
 
-import jax.numpy as jnp
 
-from raft_tpu.sparse import COO, coo_from_dense
+from raft_tpu.sparse import coo_from_dense
 from raft_tpu.sparse.mst import boruvka_mst
 from raft_tpu.sparse.connect import connect_components, get_n_components
 from raft_tpu.sparse.hierarchy import (
     build_sorted_mst,
-    build_dendrogram_host,
     extract_flattened_clusters,
     single_linkage,
 )

@@ -1,0 +1,59 @@
+"""Packaging contract — the analog of the reference's install surface
+(build.sh targets, python/pylibraft/setup.py, conda recipes) and its
+include-test (python/raft/raft/test/test_raft.py importability check).
+
+Asserts the distribution is installable: metadata parses, the package
+imports from a clean subprocess, the native runtime's C++ source ships
+with the package (the wheel is pure-Python; the .so builds lazily at
+first use and is never version-controlled).
+"""
+
+import os
+import subprocess
+import sys
+
+import raft_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_version_matches_pyproject():
+    try:
+        import tomllib
+    except ImportError:  # py<3.11
+        return
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    assert meta["project"]["version"] == raft_tpu.__version__
+    assert meta["project"]["name"] == "raft-tpu"
+
+
+def test_native_source_ships_in_package_dir():
+    src = os.path.join(
+        os.path.dirname(raft_tpu.__file__), "native", "src", "host_algos.cpp"
+    )
+    assert os.path.exists(src), "native runtime source must ship with the package"
+
+
+def test_no_binaries_in_tree():
+    pkg = os.path.dirname(raft_tpu.__file__)
+    committed = subprocess.run(
+        ["git", "ls-files", "--", "*.so"], capture_output=True, text=True,
+        cwd=REPO,
+    )
+    if committed.returncode == 0:  # inside a git checkout
+        assert committed.stdout.strip() == "", (
+            f"compiled binaries are version-controlled: {committed.stdout}"
+        )
+    del pkg
+
+
+def test_clean_subprocess_import():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import raft_tpu; print(raft_tpu.__version__)"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == raft_tpu.__version__

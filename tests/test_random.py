@@ -3,7 +3,6 @@ and cluster-recovery like cpp/test/random/make_blobs.cu."""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from raft_tpu import random as rrandom
 from raft_tpu.random import RngState

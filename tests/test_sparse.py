@@ -3,7 +3,6 @@ cpp/test/sparse/{sort,filter,convert_coo,convert_csr,norm,symmetrize,
 add,dist_coo_spmv,knn,knn_graph}.cu patterns)."""
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

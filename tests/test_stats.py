@@ -69,7 +69,8 @@ def test_contingency_matrix():
 def naive_ari(yt, yp):
     classes_t = np.unique(yt)
     classes_p = np.unique(yp)
-    c = np.array([[(np.logical_and(yt == i, yp == j)).sum() for j in classes_p] for i in classes_t], float)
+    c = np.array([[(np.logical_and(yt == i, yp == j)).sum() for j in classes_p]
+                  for i in classes_t], float)
     comb = lambda x: x * (x - 1) / 2
     sum_c = comb(c).sum()
     a = comb(c.sum(1)).sum()
