@@ -6,13 +6,17 @@ single-linkage hierarchical clustering.
 TPU representation: static-capacity padded arrays as pytrees (see coo.py).
 """
 
-from raft_tpu.sparse.coo import COO, CSR, coo_from_dense, csr_from_coo, coo_from_csr
+from raft_tpu.sparse.coo import (
+    COO, CSR, coo_from_dense, csr_from_coo, coo_from_csr, csr_from_scipy,
+)
 from raft_tpu.sparse import op
 from raft_tpu.sparse import linalg
 from raft_tpu.sparse.distance import (
     densify_rows,
     sparse_pairwise_distance,
     sparse_brute_force_knn,
+    SparseColBlockIndex,
+    sparse_colblock_index_build,
 )
 from raft_tpu.sparse.knn_graph import knn_graph
 
@@ -22,10 +26,13 @@ __all__ = [
     "coo_from_dense",
     "csr_from_coo",
     "coo_from_csr",
+    "csr_from_scipy",
     "op",
     "linalg",
     "densify_rows",
     "sparse_pairwise_distance",
     "sparse_brute_force_knn",
+    "SparseColBlockIndex",
+    "sparse_colblock_index_build",
     "knn_graph",
 ]

@@ -19,7 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["COO", "CSR", "coo_from_dense", "csr_from_coo", "coo_from_csr"]
+__all__ = [
+    "COO", "CSR", "coo_from_dense", "csr_from_coo", "coo_from_csr",
+    "csr_from_scipy",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -134,6 +137,21 @@ def csr_from_coo(coo: COO, *, sorted_rows: bool = False) -> CSR:
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
     )
     return CSR(indptr, coo.cols, coo.vals, coo.nnz, coo.shape)
+
+
+def csr_from_scipy(sp) -> CSR:
+    """Host-side constructor from any scipy sparse matrix (the
+    ``__cuda_array_interface__``-style ingestion boundary of the reference's
+    Python layer, here for the scipy ecosystem)."""
+    sp = sp.tocsr()
+    sp.sum_duplicates()
+    return CSR(
+        jnp.asarray(sp.indptr.astype(np.int32)),
+        jnp.asarray(sp.indices.astype(np.int32)),
+        jnp.asarray(sp.data.astype(np.float32)),
+        jnp.int32(sp.nnz),
+        sp.shape,
+    )
 
 
 def coo_from_csr(csr: CSR) -> COO:

@@ -207,3 +207,161 @@ def test_fit_embedding_separates_components(rng_np):
     csr = csr_from_coo(coo_from_dense(dense))
     emb = np.asarray(slinalg.fit_embedding(csr, 2, seed=0))
     assert emb.shape == (12, 2)
+
+
+# ---------------------------------------------------------------------------
+# colblock strategy (high-d, non-densifying — VERDICT r1 item 6; reference
+# hash strategy, sparse/distance/detail/coo_spmv_strategies/hash_strategy.cuh)
+# ---------------------------------------------------------------------------
+
+from raft_tpu.sparse import csr_from_scipy  # noqa: E402
+
+
+def _scipy_rand(rng, m, d, nnz_per_row):
+    import scipy.sparse as ss
+
+    density = nnz_per_row / d
+    return ss.random(
+        m, d, density=density, format="csr", dtype=np.float32,
+        random_state=rng, data_rvs=lambda k: rng.random(k).astype(np.float32),
+    )
+
+
+def test_sparse_colblock_matches_dense_all_metrics(rng_np):
+    """Strategy equivalence on every metric family the dense path serves."""
+    da, ca = random_sparse(rng_np, 17, 40, density=0.3)
+    db, cb = random_sparse(rng_np, 13, 40, density=0.3)
+    A, B = csr_from_coo(ca), csr_from_coo(cb)
+    for metric in (
+        "sqeuclidean", "euclidean", "cosine", "correlation", "inner_product",
+        "hellinger", "l1", "chebyshev", "canberra", "braycurtis", "hamming",
+    ):
+        dense = np.asarray(
+            sparse_pairwise_distance(A, B, metric, strategy="dense")
+        )
+        colb = np.asarray(
+            sparse_pairwise_distance(
+                A, B, metric, strategy="colblock", col_block=16, block_n=8
+            )
+        )
+        np.testing.assert_allclose(colb, dense, rtol=1e-4, atol=1e-4,
+                                   err_msg=metric)
+
+
+def test_sparse_highdim_knn_vs_scipy(rng_np):
+    """d = 120k kNN through the non-densifying path, scipy.sparse oracle
+    (20-newsgroups-like shape scaled for the CPU test harness; the full
+    n~20k shape runs in bench/bench_sparse.py on TPU)."""
+    d = 120_000
+    idx_sp = _scipy_rand(rng_np, 400, d, 30)
+    qry_sp = _scipy_rand(rng_np, 120, d, 30)
+    index, queries = csr_from_scipy(idx_sp), csr_from_scipy(qry_sp)
+
+    k = 7
+    dist, ids = sparse_brute_force_knn(
+        index, queries, k, metric="sqeuclidean",
+        strategy="colblock", col_block=8192, block_n=256,
+    )
+    dist, ids = np.asarray(dist), np.asarray(ids)
+
+    # scipy oracle: ||q||^2 + ||x||^2 - 2 q.x^T (exact on sparse data)
+    g = (qry_sp @ idx_sp.T).toarray()
+    qn = np.asarray(qry_sp.multiply(qry_sp).sum(1)).ravel()
+    xn = np.asarray(idx_sp.multiply(idx_sp).sum(1)).ravel()
+    full = np.maximum(qn[:, None] + xn[None, :] - 2.0 * g, 0.0)
+    want_i = np.argsort(full, 1, kind="stable")[:, :k]
+    want_d = np.take_along_axis(full, want_i, 1)
+
+    np.testing.assert_allclose(dist, want_d, rtol=1e-4, atol=1e-4)
+    # indices may differ on ties; distances of chosen ids must match
+    got_d = np.take_along_axis(full, ids, 1)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_highdim_pairwise_cosine_vs_scipy(rng_np):
+    d = 60_000
+    a_sp = _scipy_rand(rng_np, 150, d, 25)
+    b_sp = _scipy_rand(rng_np, 90, d, 25)
+    got = np.asarray(
+        sparse_pairwise_distance(
+            csr_from_scipy(a_sp), csr_from_scipy(b_sp), "cosine",
+            strategy="colblock", col_block=8192, block_n=64,
+        )
+    )
+    g = (a_sp @ b_sp.T).toarray()
+    an = np.sqrt(np.asarray(a_sp.multiply(a_sp).sum(1))).ravel()
+    bn = np.sqrt(np.asarray(b_sp.multiply(b_sp).sum(1))).ravel()
+    denom = an[:, None] * bn[None, :]
+    want = 1.0 - g / np.where(denom == 0, 1.0, denom)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_auto_picks_colblock_highdim(rng_np):
+    """auto must route a high-d problem through colblock (no (n, d) dense)
+    and still agree with the dense answer computed at the same small n."""
+    d = 500_000  # a dense index block would be 4 GB — auto must not densify
+    idx_sp = _scipy_rand(rng_np, 2000, d, 10)
+    qry_sp = _scipy_rand(rng_np, 20, d, 10)
+    dist, ids = sparse_brute_force_knn(
+        csr_from_scipy(idx_sp), csr_from_scipy(qry_sp), 3,
+        metric="sqeuclidean", col_block=65_536,
+    )
+    g = (qry_sp @ idx_sp.T).toarray()
+    qn = np.asarray(qry_sp.multiply(qry_sp).sum(1)).ravel()
+    xn = np.asarray(idx_sp.multiply(idx_sp).sum(1)).ravel()
+    full = np.maximum(qn[:, None] + xn[None, :] - 2.0 * g, 0.0)
+    want_i = np.argsort(full, 1, kind="stable")[:, :3]
+    np.testing.assert_allclose(
+        np.asarray(dist), np.take_along_axis(full, want_i, 1),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_sparse_prebuilt_colblock_index(rng_np):
+    """Prebuilt index path == CSR colblock path == scipy oracle, across
+    expanded + unexpanded metrics."""
+    from raft_tpu.sparse import sparse_colblock_index_build
+
+    d = 50_000
+    idx_sp = _scipy_rand(rng_np, 300, d, 40)
+    qry_sp = _scipy_rand(rng_np, 80, d, 40)
+    queries = csr_from_scipy(qry_sp)
+    layout = sparse_colblock_index_build(idx_sp, col_block=8192)
+
+    for metric in ("sqeuclidean", "cosine", "l1"):
+        dl, il = sparse_brute_force_knn(layout, queries, 5, metric=metric)
+        dc, ic = sparse_brute_force_knn(
+            csr_from_scipy(idx_sp), queries, 5, metric=metric,
+            strategy="colblock", col_block=8192,
+        )
+        np.testing.assert_allclose(
+            np.asarray(dl), np.asarray(dc), rtol=1e-4, atol=1e-4,
+            err_msg=metric,
+        )
+    # scipy oracle on sqeuclidean
+    g = (qry_sp @ idx_sp.T).toarray()
+    qn = np.asarray(qry_sp.multiply(qry_sp).sum(1)).ravel()
+    xn = np.asarray(idx_sp.multiply(idx_sp).sum(1)).ravel()
+    full = np.maximum(qn[:, None] + xn[None, :] - 2.0 * g, 0.0)
+    want_i = np.argsort(full, 1, kind="stable")[:, :5]
+    dl, il = sparse_brute_force_knn(layout, queries, 5, metric="sqeuclidean")
+    np.testing.assert_allclose(
+        np.asarray(dl), np.take_along_axis(full, want_i, 1),
+        rtol=1e-4, atol=1e-4,
+    )
+    # pairwise facade accepts the layout too
+    pd = sparse_pairwise_distance(queries, layout, "sqeuclidean")
+    np.testing.assert_allclose(np.asarray(pd), full, rtol=1e-4, atol=1e-3)
+
+
+def test_sparse_colblock_index_build_from_csr(rng_np):
+    from raft_tpu.sparse import sparse_colblock_index_build
+
+    dense, coo = random_sparse(rng_np, 20, 30, density=0.3)
+    layout = sparse_colblock_index_build(csr_from_coo(coo), col_block=8)
+    qd, qcoo = random_sparse(rng_np, 10, 30, density=0.3)
+    got = np.asarray(
+        sparse_pairwise_distance(csr_from_coo(qcoo), layout, "sqeuclidean")
+    )
+    want = ((qd[:, None, :] - dense[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
