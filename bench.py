@@ -1,62 +1,286 @@
-"""Headline benchmark — pairwise L2 distance throughput on TPU.
+"""Headline benchmark — the BASELINE.md north-star configs on one chip.
 
-Mirrors the reference's distance benchmark (cpp/bench/distance/distance_exp_l2.cu
-via the shared harness cpp/bench/distance/distance_common.cuh): time the
-expanded-L2 pairwise distance engine on a large square problem, using the
-shared loop-in-jit harness (bench/common.py — two-point difference timing
-cancels the ~100 ms fixed dispatch+fetch cost of the axon tunnel; a
-full-output reduce pins the dependence so XLA cannot narrow the measured
-computation).
+Emits ONE JSON line. The primary metric stays the pairwise expanded-L2
+engine (reference cpp/bench/distance/distance_exp_l2.cu shape family);
+``extras`` carries the other BASELINE.md targets so the artifact parses
+every north star (VERDICT r1 item 3):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+* brute-force kNN QPS at the largest single-chip-honest scale — the
+  10M x 768 regime via bf16 index storage (~14 GB HBM-resident; the fused
+  chunk-min kernel never materialises the m x n matrix and reads the index
+  in its storage dtype, so no f32 copy exists),
+* k-means seconds/iter at 1M x 128, k=1024,
+* IVF-PQ search QPS with recall@10 on the same line (recall-qualified,
+  exact-refined).
 
-vs_baseline is value / 10_000 GFLOPS — a RAFT-on-A100 estimate for the f32
-pairwise-distance suite (the reference publishes no absolute numbers;
-BASELINE.md records `"published": {}`), i.e. vs_baseline >= 1.0 means we beat
-the A100 reference estimate.
+Methodology: loop-in-jit two-point-difference timing (bench/common.py)
+cancels the ~100 ms axon-tunnel dispatch cost; k-means uses a
+two-program difference quotient on fresh inputs instead (its while_loop
+iteration count is data-dependent, and the axon runtime memoizes
+executions with identical inputs). Large operands are generated on
+device (jax.random) so the tunnel never transfers gigabytes.
+
+vs_baseline is headline GFLOPS / 10_000 — a RAFT-on-A100 estimate for the
+f32 pairwise-distance suite (the reference publishes no absolute numbers;
+BASELINE.md records `"published": {}`); >= 1.0 beats the estimate.
 """
 
 import contextlib
 import io
 import json
+import subprocess
+import sys
+import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from bench.common import bench_fn
-from raft_tpu.distance.pairwise import _expanded_impl
 from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.distance.pairwise import _expanded_impl
 
 
-def main():
+def _quiet_bench(fn, *args, iters):
+    with contextlib.redirect_stdout(io.StringIO()):
+        return bench_fn(fn, *args, iters=iters, name="x")
+
+
+def headline_pairwise():
     m = n = 8192
     d = 512
-
     rng = np.random.default_rng(42)
     # f32 operands + default MXU precision: measured fastest on v5e (the
     # bf16-input path currently hits an XLA layout-conversion slowdown —
     # see bench/bench_distance.py for the full grid)
     x = jax.device_put(rng.standard_normal((m, d)).astype(np.float32))
     y = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
-
-    with contextlib.redirect_stdout(io.StringIO()):  # suppress harness line
-        ms = bench_fn(
-            lambda a, b: _expanded_impl(DistanceType.L2Expanded, a, b, "default"),
-            x, y, iters=40, name="headline",
-        )
-
-    gflops = 2.0 * m * n * d / (ms / 1e3) / 1e9
-    print(
-        json.dumps(
-            {
-                "metric": "pairwise_l2_expanded_8192x8192x512_f32",
-                "value": round(gflops, 1),
-                "unit": "GFLOPS",
-                "vs_baseline": round(gflops / 10_000.0, 3),
-            }
-        )
+    ms = _quiet_bench(
+        lambda a, b: _expanded_impl(DistanceType.L2Expanded, a, b, "default"),
+        x, y, iters=40,
     )
+    return 2.0 * m * n * d / (ms / 1e3) / 1e9
+
+
+def extra_big_knn():
+    """kNN QPS at 9.2M x 768: bf16-resident index held as 3 partitions
+    (each partition's Pallas grid stays under the compile-helper's
+    per-program step limit; no monolithic copy ever exists), fused
+    chunk-min per partition, knn_merge_parts across them — the reference's
+    multi-partition search shape (knn_brute_force_faiss.cuh:289-368) at
+    the BASELINE 10M x 768 regime.
+
+    Timed by sequential async dispatches with one terminal sync (NOT the
+    loop-in-jit harness: fusing the three Pallas calls into one looped
+    program exceeds the per-program grid-step limit). Distinct query
+    values per dispatch defeat the axon result memoization; the
+    difference quotient T(n2) - T(n1) cancels the terminal round trip."""
+    from raft_tpu.spatial.knn import brute_force_knn
+
+    d, nq, k = 768, 1024, 10
+    part_rows, n_parts = 3_072_000, 3
+    n = part_rows * n_parts
+    key = jax.random.PRNGKey(0)
+
+    # synthetic index data from fused iota+sin: jax.random.normal would
+    # materialize 9.4 GB of uint32 threefry bits per part next to the
+    # already-resident parts (OOM); throughput here is data-independent
+    @jax.jit
+    def synth(seed):
+        i = jax.lax.broadcasted_iota(jnp.float32, (part_rows, d), 0)
+        j = jax.lax.broadcasted_iota(jnp.float32, (part_rows, d), 1)
+        return jnp.sin(i * 1.13e-4 + j * 7.1e-2 + seed).astype(jnp.bfloat16)
+
+    parts = [synth(float(s)) for s in range(n_parts)]
+
+    def search(qq):
+        return brute_force_knn(
+            parts, qq, k, metric=DistanceType.L2Expanded,
+            use_fused=True, compute_dtype=jnp.bfloat16, extra_chunks=32,
+        )
+
+    def timed(n_disp, seed):
+        qs = [
+            jax.random.normal(jax.random.fold_in(key, seed + i), (nq, d),
+                              jnp.float32)
+            for i in range(n_disp)
+        ]
+        float(sum(jnp.sum(qq) for qq in qs))  # materialize inputs first
+        t0 = time.perf_counter()
+        # chain each search on the previous result: device-serialized, so
+        # only ONE search's transients are live (8 concurrent in-flight
+        # searches next to the 14 GB index would exhaust HBM), and still
+        # a single terminal sync
+        prev = jnp.float32(0.0)
+        for i in range(n_disp):
+            v, _ = search(qs[i] + prev * 0)
+            prev = jnp.sum(v)
+        float(prev)
+        return time.perf_counter() - t0
+
+    float(jnp.sum(search(jax.random.normal(key, (nq, d), jnp.float32))[0]))
+    n1, n2 = 2, 8
+    t1 = timed(n1, 1000)
+    t2 = timed(n2, 2000)
+    ms = (t2 - t1) / (n2 - n1) * 1e3
+    return {
+        "metric": f"knn_fused_bf16_{n}x{d}_q{nq}_k{k}",
+        "value": round(nq / (ms / 1e3), 1),
+        "unit": "QPS",
+        "index_gb": round(n * d * 2 / 1e9, 1),
+        "partitions": n_parts,
+    }
+
+
+def extra_kmeans():
+    """BASELINE.md config: 1M x 128, k=1024 (two-program difference)."""
+    from raft_tpu.cluster import KMeansParams, kmeans_fit
+
+    n, d, k = 1_000_000, 128, 1024
+    x = jax.random.normal(jax.random.PRNGKey(1), (n, d), jnp.float32)
+    p5 = KMeansParams(n_clusters=k, max_iter=5, tol=0.0, seed=0)
+    p20 = KMeansParams(n_clusters=k, max_iter=20, tol=0.0, seed=0)
+    float(kmeans_fit(x, p5).inertia)      # compile both programs
+    float(kmeans_fit(x, p20).inertia)
+    x2 = x * jnp.float32(1.0001)          # fresh values: defeat memoization
+    t0 = time.perf_counter()
+    out5 = kmeans_fit(x2, p5)
+    float(out5.inertia)
+    t5 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out20 = kmeans_fit(x2, p20)
+    float(out20.inertia)
+    t20 = time.perf_counter() - t0
+    per_iter = (t20 - t5) / (int(out20.n_iter) - int(out5.n_iter))
+    return {
+        "metric": f"kmeans_{n}x{d}_k{k}",
+        "value": round(1.0 / per_iter, 2),
+        "unit": "iters_per_s",
+        "s_per_iter": round(per_iter, 4),
+    }
+
+
+def extra_ivf_pq():
+    """IVF-PQ refined search QPS with recall@10 vs an exact oracle.
+
+    Data is clustered (make_blobs, 1000 centers) — the regime real
+    embedding corpora live in and the one IVF exists for; on isotropic
+    Gaussian data (no cluster structure, distance concentration at d=96)
+    recall@10 measures ~0.19 at the same settings for ANY inverted-file
+    method — that is a property of the adversarial dataset, not the
+    index (measured, see bench/bench_ann.py)."""
+    from raft_tpu.random import make_blobs
+    from raft_tpu.random.rng import RngState
+    from raft_tpu.spatial.ann import IVFPQParams, ivf_pq_build, ivf_pq_search
+    from raft_tpu.spatial.fused_knn import fused_l2_knn
+
+    n, d, nq, k = 500_000, 96, 4096, 10
+    key = jax.random.PRNGKey(2)
+    x, _ = make_blobs(n, d, n_clusters=1000, cluster_std=1.0,
+                      state=RngState(7))
+    # queries: perturbed dataset points (realistic: queries come from the
+    # same distribution as the corpus)
+    base = jax.random.choice(key, x, shape=(nq,), axis=0)
+    q = base + 0.3 * jax.random.normal(
+        jax.random.fold_in(key, 1), (nq, d), jnp.float32
+    )
+    _, true_ids = fused_l2_knn(q, x, k, metric=DistanceType.L2Expanded)
+    true_np = np.asarray(true_ids)
+
+    t0 = time.perf_counter()
+    # 2048 lists halve the worst-case padded list length on 1000-blob data;
+    # pq_dim=24 (4 dims/subspace) sharpens ADC on the near-isotropic
+    # intra-blob residuals: recall@10 0.95 at n_probes=16 (measured sweep)
+    pq = ivf_pq_build(x, IVFPQParams(
+        n_lists=2048, pq_dim=24, kmeans_n_iters=10, kmeans_init="random",
+    ))
+    jax.block_until_ready(pq.centroids)
+    build_s = time.perf_counter() - t0
+
+    n_probes, refine = 16, 4.0
+
+    def search(qq):
+        return ivf_pq_search(
+            index=pq, queries=qq, k=k, n_probes=n_probes, refine_ratio=refine,
+        )
+
+    # chained-dispatch two-point timing (same rationale as extra_big_knn:
+    # the search program is too large for the loop-in-jit harness)
+    float(jnp.sum(search(q)[0]))  # compile + warm
+    def timed(n_disp, seed):
+        qs = [
+            q * (1.0 + 1e-6 * (seed + i)) for i in range(n_disp)
+        ]
+        float(sum(jnp.sum(v) for v in qs))
+        t0 = time.perf_counter()
+        prev = jnp.float32(0.0)
+        for i in range(n_disp):
+            v, _ = search(qs[i] + prev * 0)
+            prev = jnp.sum(v)
+        float(prev)
+        return time.perf_counter() - t0
+
+    t1 = timed(2, 10)
+    t2 = timed(8, 100)
+    ms = (t2 - t1) / 6 * 1e3
+    got = np.asarray(search(q)[1])
+    hits = sum(
+        len(set(g.tolist()) & set(t.tolist()))
+        for g, t in zip(got, true_np)
+    )
+    return {
+        "metric": f"ivf_pq_refined_{n}x{d}_q{nq}_k{k}_p{n_probes}",
+        "value": round(nq / (ms / 1e3), 1),
+        "unit": "QPS",
+        "recall_at_10": round(hits / true_np.size, 4),
+        "build_s": round(build_s, 2),
+    }
+
+
+_EXTRAS = {
+    "big_knn": extra_big_knn,
+    "kmeans": extra_kmeans,
+    "ivf_pq": extra_ivf_pq,
+}
+
+
+def main():
+    gflops = headline_pairwise()
+    # each extra runs in its own subprocess: a clean HBM arena per config
+    # (a failed 14 GB allocation must not poison the next measurement)
+    extras = []
+    for name in _EXTRAS:
+        out = None
+        try:
+            out = subprocess.run(
+                [sys.executable, __file__, "--extra", name],
+                capture_output=True, text=True, timeout=1200,
+            )
+            line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+            extras.append(json.loads(line))
+        except Exception as e:
+            tail = (out.stderr or "")[-200:] if out is not None else ""
+            extras.append({
+                "metric": name,
+                "error": f"{type(e).__name__}: {e} {tail}"[:300],
+            })
+    print(json.dumps({
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": round(gflops, 1),
+        "unit": "GFLOPS",
+        "vs_baseline": round(gflops / 10_000.0, 3),
+        "extras": extras,
+    }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--extra":
+        try:
+            print(json.dumps(_EXTRAS[sys.argv[2]]()))
+        except Exception as e:
+            print(json.dumps({
+                "metric": sys.argv[2],
+                "error": f"{type(e).__name__}: {e}"[:300],
+            }))
+    else:
+        main()

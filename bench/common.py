@@ -55,17 +55,22 @@ def bench_fn(make_fn: Callable, *args, iters: int = 40, name: str = "",
             return acc + sum(leaves)
         return lax.fori_loop(0, n, body, jnp.float32(0.0))
 
-    n0 = max(iters // 8, 1)
+    n0 = min(max(iters // 8, 1), 5_000)  # < cap so growth keeps n2 > n1
     float(loop(n0, *args))  # compile (n is a runtime arg: one program)
     t0 = _median_of(lambda: float(loop(n0, *args)), reps=3)
-    # pilot to size n2 so the compute delta dominates the ~10-30 ms jitter
-    # of the fixed dispatch cost; n1 = n2/4 keeps both points in the same
-    # jitter regime and median-of-5 resists asymmetric outliers
-    t_pilot = _median_of(lambda: float(loop(4 * n0, *args)), reps=1)
-    per_iter_est = max((t_pilot - t0) / (3 * n0), 1e-6)
-    n2 = int(min(max(iters, 1.0 / per_iter_est), 20_000))
-    n1 = max(n2 // 4, 1)
-    n2 = max(n2, n1 + 1)  # slow workloads can pilot to n2 == n1 == 1
+    # grow the loop length by MEASURED time until the compute delta
+    # dominates the ~10-30 ms dispatch jitter. Growth is bounded by the
+    # observed wall clock, so a mis-estimated per-iteration cost can never
+    # schedule an hours-long fused loop (which the TPU watchdog would kill
+    # as a "worker crash") — the failure mode of estimate-based sizing.
+    n1, t1 = n0, t0
+    n2 = min(4 * n0, 20_000)
+    t2 = _median_of(lambda: float(loop(n2, *args)), reps=1)
+    while t2 < 0.4 and n2 < 20_000:
+        n1, t1 = n2, t2
+        n2 = min(n2 * 4, 20_000)
+        t2 = _median_of(lambda: float(loop(n2, *args)), reps=1)
+    # refine both points with medians (resists asymmetric outliers)
     t1 = _median_of(lambda: float(loop(n1, *args)))
     t2 = _median_of(lambda: float(loop(n2, *args)))
     ms = max(t2 - t1, 1e-9) / (n2 - n1) * 1e3

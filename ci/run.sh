@@ -37,10 +37,20 @@ run_tests() {
         python -m pytest tests/ -q
 }
 
+run_docs() {
+    echo "== docs (API reference regenerates cleanly) =="
+    JAX_PLATFORMS=cpu python docs/gen_api.py
+    # porcelain catches untracked pages too (a new module's page is
+    # untracked, which git diff would ignore)
+    [ -z "$(git status --porcelain -- docs/api)" ] \
+        || { echo "docs/api is stale: run python docs/gen_api.py"; exit 1; }
+}
+
 case "$stage" in
     style) run_style ;;
     test) run_tests ;;
-    all) run_style; run_install_check; run_tests ;;
-    *) echo "unknown stage: $stage (style|test|all)"; exit 2 ;;
+    docs) run_docs ;;
+    all) run_style; run_install_check; run_docs; run_tests ;;
+    *) echo "unknown stage: $stage (style|test|docs|all)"; exit 2 ;;
 esac
 echo "CI: OK"

@@ -10,6 +10,7 @@ tagged p2p.
 from raft_tpu.comms.comms import (
     AxisComms,
     Comms,
+    P2PBatch,
     ReduceOp,
     build_comms,
     inject_comms,
@@ -22,6 +23,7 @@ from raft_tpu.comms.ring import ring_knn, ring_pairwise_distance
 __all__ = [
     "AxisComms",
     "Comms",
+    "P2PBatch",
     "ReduceOp",
     "build_comms",
     "inject_comms",

@@ -38,7 +38,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["ReduceOp", "AxisComms", "Comms", "build_comms", "inject_comms"]
+from raft_tpu import errors
+
+__all__ = ["ReduceOp", "AxisComms", "P2PBatch", "Comms", "build_comms", "inject_comms"]
 
 
 class ReduceOp(enum.Enum):
@@ -146,6 +148,12 @@ class AxisComms:
         perm = [(i, (i + shift) % n) for i in range(n)]
         return lax.ppermute(x, self.axis, perm)
 
+    def p2p_batch(self) -> "P2PBatch":
+        """Deferred tagged point-to-point batch — the analog of the
+        reference's ``isend``/``irecv``/``waitall`` (core/comms.hpp:440-508,
+        UCX-tagged in std_comms.hpp:264-463). See :class:`P2PBatch`."""
+        return P2PBatch(self)
+
     def device_multicast_sendrecv(self, x, sources: Sequence[int], dest: int):
         """comms.hpp:570: gather several sources' buffers at ``dest``; SPMD
         form returns the stacked sources on every rank."""
@@ -163,6 +171,115 @@ class AxisComms:
         computation's own error semantics (reference std_comms sync_stream
         polls NCCL async errors)."""
         return None
+
+
+class P2PBatch:
+    """Tagged, deferred point-to-point transfers over a mesh axis.
+
+    The reference records nonblocking ``isend``/``irecv`` requests and
+    completes them in ``waitall`` (core/comms.hpp:440-508; UCX tags,
+    std_comms.hpp:264-463). SPMD under XLA traces one program for every
+    rank, so the pattern is declared collectively: every rank records the
+    SAME (src, dst, tag) entries, each passing its local candidate value;
+    ``waitall`` batches each tag's pairs into the minimum number of
+    ``ppermute`` rounds (splitting when a source or destination repeats
+    within a tag — the "multiple in-flight transfers" the reference's tags
+    exist for) and returns the delivered arrays keyed by (src, dst, tag).
+
+    Usage (inside shard_map):
+        p2p = comms.p2p_batch()
+        p2p.isend(my_block, src=0, dest=3, tag=0)
+        p2p.irecv(src=0, dest=3, tag=0)
+        got = p2p.waitall()[(0, 3, 0)]   # my_block of rank 0 on rank 3
+
+    A rank that is not the destination of a transfer reads zeros for it
+    (ppermute semantics) — callers mask by ``get_rank()`` exactly as
+    reference callers guard on ``comm.get_rank()``.
+    """
+
+    def __init__(self, comms: AxisComms):
+        self._comms = comms
+        self._sends = []   # (src, dst, tag, value)
+        self._recvs = []   # (src, dst, tag)
+
+    def isend(self, x, src: int, dest: int, tag: int = 0) -> None:
+        errors.expects(src != dest, "p2p: src == dest == %d", src)
+        self._sends.append((int(src), int(dest), int(tag), jnp.asarray(x)))
+
+    def irecv(self, src: int, dest: int, tag: int = 0) -> Tuple[int, int, int]:
+        key = (int(src), int(dest), int(tag))
+        self._recvs.append(key)
+        return key
+
+    def waitall(self):
+        """Execute all recorded transfers; returns {(src, dst, tag): array}.
+
+        Validates the send/recv sets match, as the reference's waitall
+        contract implies (an unmatched tag hangs a UCX endpoint; here it
+        is an immediate error)."""
+        send_keys = [(s, d, t) for s, d, t, _ in self._sends]
+        sends = set(send_keys)
+        recvs = set(self._recvs)
+        # duplicate (src, dst, tag) keys are ambiguous — the result dict
+        # could only hold one of them (the UCX reference disambiguates by
+        # distinct tags; require the same here)
+        errors.expects(
+            len(send_keys) == len(sends),
+            "p2p waitall: duplicate (src, dst, tag) sends %s — use distinct "
+            "tags per in-flight transfer",
+            sorted(k for k in sends if send_keys.count(k) > 1),
+        )
+        errors.expects(
+            len(self._recvs) == len(recvs),
+            "p2p waitall: duplicate (src, dst, tag) recvs %s",
+            sorted(k for k in recvs if self._recvs.count(k) > 1),
+        )
+        errors.expects(
+            sends == recvs,
+            "p2p waitall: unmatched transfers (sends-only %s, recvs-only %s)",
+            sorted(sends - recvs), sorted(recvs - sends),
+        )
+        rank = self._comms.get_rank()
+        out = {}
+        by_tag = {}
+        for s, d, t, v in self._sends:
+            by_tag.setdefault(t, []).append((s, d, v))
+        for t, entries in sorted(by_tag.items()):
+            # greedy rounds: within a round every src and dst is unique
+            remaining = list(entries)
+            while remaining:
+                round_entries, used_s, used_d, rest = [], set(), set(), []
+                for s, d, v in remaining:
+                    if s in used_s or d in used_d:
+                        rest.append((s, d, v))
+                    else:
+                        round_entries.append((s, d, v))
+                        used_s.add(s)
+                        used_d.add(d)
+                remaining = rest
+                shapes = {(v.shape, v.dtype.name) for _, _, v in round_entries}
+                errors.expects(
+                    len(shapes) == 1,
+                    "p2p: one ppermute round needs uniform shapes, got %s",
+                    sorted(shapes),
+                )
+                # each rank contributes the value of ITS send in this round
+                payload = sum(
+                    jnp.where(rank == s, v, jnp.zeros_like(v))
+                    for s, _, v in round_entries
+                )
+                perm = [(s, d) for s, d, _ in round_entries]
+                delivered = self._comms.sendrecv(payload, perm)
+                for s, d, _ in round_entries:
+                    # per-transfer masking: a round's single ppermute result
+                    # holds whatever THIS rank received; only the transfer
+                    # whose destination is this rank may expose it — every
+                    # other key reads zeros (the documented contract)
+                    out[(s, d, t)] = jnp.where(
+                        rank == d, delivered, jnp.zeros_like(delivered)
+                    )
+        self._sends, self._recvs = [], []
+        return out
 
 
 class Comms:

@@ -17,6 +17,7 @@ cancellation is cooperative, not preemptive).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -94,14 +95,24 @@ class Interruptible:
         cls.get_token(thread_id).cancel()
 
     @classmethod
-    def synchronize(cls, x) -> None:
+    def synchronize(cls, x, *, poll_interval_s: float = 0.001) -> None:
         """Cancellable wait on a jax array / pytree.
 
-        Unlike the reference we cannot poll device completion at fine grain;
-        we check the token before and after blocking. For long host loops,
-        call :meth:`yield_now` between dispatches instead (same guidance as
-        the reference gives for compute-heavy loops).
+        The exact analog of the reference's polling loop
+        (interruptible.hpp:66-120: ``cudaStreamQuery`` + token check +
+        ``std::this_thread::yield``): poll ``Array.is_ready()`` on every
+        leaf, checking this thread's token between polls, so ``cancel()``
+        from another thread breaks an IN-FLIGHT wait — the dispatched
+        device work itself still completes (cancellation is cooperative,
+        as in the reference). Leaves without ``is_ready`` (plain numpy /
+        scalars) are treated as ready.
         """
-        cls.yield_now()
-        jax.block_until_ready(x)
-        cls.yield_now()
+        leaves = [
+            leaf for leaf in jax.tree.leaves(x) if hasattr(leaf, "is_ready")
+        ]
+        while True:
+            cls.yield_now()
+            leaves = [leaf for leaf in leaves if not leaf.is_ready()]
+            if not leaves:
+                return
+            time.sleep(poll_interval_s)  # the std::this_thread::yield slot

@@ -103,8 +103,12 @@ def check_matrix(x: Any, name: str, *, ndim: int = 2,
         "%s: expected a %dD array, got shape %s", name, ndim, shape,
     )
     dt = np.dtype(x.dtype)
+    # ml_dtypes extension floats (bfloat16, float8_*) have numpy kind 'V';
+    # ask jax's dtype lattice about those
+    import jax.numpy as jnp
+
     expects(
-        dt.kind in _REAL_KINDS,
+        dt.kind in _REAL_KINDS or jnp.issubdtype(dt, jnp.floating),
         "%s: expected a real numeric dtype, got %s", name, dt,
     )
     expects(

@@ -42,7 +42,7 @@ from jax.experimental import pallas as pl
 
 from raft_tpu.distance.distance_type import DistanceType
 
-__all__ = ["fused_l2_knn", "fused_knn_supported"]
+__all__ = ["fused_l2_knn", "fused_knn_supported", "fused_grid_ok"]
 
 _CHUNK = 128  # lane width: one chunk-min per vreg row per reduce
 
@@ -109,7 +109,7 @@ def _chunk_mins(
 @functools.partial(
     jax.jit,
     static_argnames=("k", "metric", "bm", "bn", "bq2", "extra_chunks",
-                     "compute_dtype", "interpret"),
+                     "compute_dtype", "interpret", "gather_rows"),
 )
 def _fused_l2_knn_impl(
     queries,
@@ -123,6 +123,7 @@ def _fused_l2_knn_impl(
     extra_chunks: int,
     compute_dtype,
     interpret: bool,
+    gather_rows=None,
 ) -> Tuple[jax.Array, jax.Array]:
     m, d = queries.shape
     n = index.shape[0]
@@ -137,9 +138,12 @@ def _fused_l2_knn_impl(
     # phase 2 rescoring (never selected); BIG is finite to keep inf-inf
     # NaNs out of the VPU.
     BIG = jnp.float32(1e30)
-    yp = jnp.pad(y, ((0, npad - n), (0, 0)))
+    # trace-level skip when already aligned: a zero-width jnp.pad of a
+    # multi-GB index is not reliably elided and would copy it (fatal for
+    # the HBM-resident big-index regime)
+    yp = y if npad == n else jnp.pad(y, ((0, npad - n), (0, 0)))
     yn = jnp.einsum("nd,nd->n", y, y, preferred_element_type=jnp.float32)
-    ynp = jnp.pad(yn, (0, npad - n), constant_values=BIG)
+    ynp = yn if npad == n else jnp.pad(yn, (0, npad - n), constant_values=BIG)
 
     cmins = _chunk_mins(
         q, yp, ynp[:, None], bm=bm, bn=bn,
@@ -160,7 +164,18 @@ def _fused_l2_knn_impl(
     c = min(nC, k + extra_chunks)
     _, cids = lax.top_k(-cmins, c)                      # (m, c)
 
-    ychunks = yp.reshape(nC, _CHUNK * d)
+    # Chunk-granular gather ((nC, 128*d) reshape) is the fast path — one
+    # 64 KB contiguous row per candidate chunk, measured ~7x per-row
+    # gathers. But the reshape RELAYOUTS the whole index (a full copy):
+    # fatal when the index is HBM-resident at the multi-GB scale, so big
+    # indexes gather 128 rows per chunk from the original layout instead.
+    big_index = (
+        gather_rows
+        if gather_rows is not None
+        else npad * d * y.dtype.itemsize > (2 << 30)
+    )
+    if not big_index:
+        ychunks = yp.reshape(nC, _CHUNK * d)
     ynchunks = ynp.reshape(nC, _CHUNK)
 
     qn = jnp.sum(q * q, axis=-1)
@@ -172,7 +187,13 @@ def _fused_l2_knn_impl(
     def rescore(args):
         qblk, qnblk, cblk = args                   # (bq2, d), (bq2,), (bq2, c)
         flat = cblk.reshape(-1)
-        yv = jnp.take(ychunks, flat, axis=0).reshape(bq2, c * _CHUNK, d)
+        if big_index:
+            rows = (
+                flat[:, None] * _CHUNK + jnp.arange(_CHUNK)[None, :]
+            ).reshape(-1)                          # (bq2*c*128,)
+            yv = jnp.take(yp, rows, axis=0).reshape(bq2, c * _CHUNK, d)
+        else:
+            yv = jnp.take(ychunks, flat, axis=0).reshape(bq2, c * _CHUNK, d)
         ynv = jnp.take(ynchunks, flat, axis=0).reshape(bq2, c * _CHUNK)
         dots = jnp.einsum(
             "qd,qcd->qc", qblk, yv,
@@ -200,6 +221,34 @@ _L2_FAMILY = (
     DistanceType.L2SqrtExpanded,
     DistanceType.L2Unexpanded,
 )
+
+_MAX_GRID_STEPS = 6000
+
+
+def _plan_blocks(m: int, n: int, d: int, bm: int = 1024, bn: int = 2048):
+    """Resolve phase-1 tile sizes: VMEM-bounded for wide d, 128-aligned."""
+    bn = min(bn, _round_up(n, _CHUNK))
+    bm = min(bm, _round_up(m, 128))  # queries ride the lane axis: 128-aligned
+    # keep the phase-1 working set (score tile + double-buffered operand
+    # tiles) inside VMEM for wide d
+    while bn > 256 and (bn * bm * 4 + 8 * d * (bn + bm)) > 12 * 2**20:
+        bn //= 2
+        if bm > 256:
+            bm //= 2
+    return bm, bn
+
+
+def _grid_steps(m: int, n: int, bm: int, bn: int) -> int:
+    return _cdiv(m, bm) * _cdiv(_round_up(n, bn), bn)
+
+
+def fused_grid_ok(m: int, n: int, d: int, bm: int = 1024,
+                  bn: int = 2048) -> bool:
+    """Whether one fused call at this shape stays under the compile
+    helper's per-program grid-step limit (callers above the limit should
+    partition the index or take the scan path)."""
+    pbm, pbn = _plan_blocks(m, n, d, bm, bn)
+    return _grid_steps(m, n, pbm, pbn) <= _MAX_GRID_STEPS
 
 
 def fused_knn_supported(
@@ -230,6 +279,8 @@ def fused_l2_knn(
     extra_chunks: int = 8,
     compute_dtype=jnp.float32,
     interpret: Optional[bool] = None,
+    gather_rows: Optional[bool] = None,
+    init: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact fused kNN for the L2 metric family. Returns (dists (m, k),
     indices (m, k)) best-first, matching ``brute_force_knn``.
@@ -237,6 +288,13 @@ def fused_l2_knn(
     ``compute_dtype=bfloat16`` halves phase-1 index traffic and doubles MXU
     rate; chunk ranking then carries bf16 error, so pair it with a larger
     ``extra_chunks`` (the bench uses 32) for near-exact recall.
+
+    ``init``: optional previous top-k ``(dists (m, k), ids (m, k))`` to
+    warm-start from — the analog of the reference's previous-top-k warm
+    path (fused_l2_knn.cuh:947 ``rowMajorQuery``). The result is the
+    merged best-of-both, so a multi-partition search can thread results
+    partition to partition; the caller owns id translation (as in the
+    reference, knn_brute_force_faiss.cuh:240-254).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -248,17 +306,32 @@ def fused_l2_knn(
         raise ValueError(
             f"fused kNN unsupported for metric={metric} m={m} n={n} d={d} k={k}"
         )
-    bn = min(bn, _round_up(n, _CHUNK))
-    bm = min(bm, _round_up(m, 128))  # queries ride the lane axis: 128-aligned
-    # keep the phase-1 working set (score tile + double-buffered operand
-    # tiles) inside VMEM for wide d
-    while bn > 256 and (bn * bm * 4 + 8 * d * (bn + bm)) > 12 * 2**20:
-        bn //= 2
-        if bm > 256:
-            bm //= 2
-    return _fused_l2_knn_impl(
+    bm, bn = _plan_blocks(m, n, d, bm, bn)
+    # the TPU compile helper rejects Pallas programs beyond ~6k total grid
+    # steps (measured: 6144 compiles, 7812 does not); beyond that the index
+    # must be partitioned — brute_force_knn(list_of_partitions) runs this
+    # kernel per partition and knn_merge_parts the results (its auto
+    # dispatch checks fused_grid_ok and falls back to the scan path).
+    steps = _grid_steps(m, n, bm, bn)
+    if steps > _MAX_GRID_STEPS:
+        raise ValueError(
+            f"fused kNN grid too large ({steps} steps > {_MAX_GRID_STEPS}): "
+            f"split the index into partitions of <= "
+            f"{_MAX_GRID_STEPS // _cdiv(m, bm) * bn} rows "
+            f"and use brute_force_knn(partitions, ...)"
+        )
+    vals, idxs = _fused_l2_knn_impl(
         queries, index, k, metric,
         bm=bm, bn=bn, bq2=bq2, extra_chunks=extra_chunks,
         compute_dtype=jnp.dtype(compute_dtype),
-        interpret=interpret,
+        interpret=interpret, gather_rows=gather_rows,
     )
+    if init is not None:
+        from raft_tpu.spatial.selection import merge_topk
+
+        init_d, init_i = init
+        vals, idxs = merge_topk(
+            vals, idxs, jnp.asarray(init_d), jnp.asarray(init_i, jnp.int32),
+            select_min=True,
+        )
+    return vals, idxs

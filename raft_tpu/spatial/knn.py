@@ -34,7 +34,9 @@ from raft_tpu.distance.pairwise import (
 )
 from raft_tpu.distance.distance_type import EXPANDED_METRICS
 from raft_tpu.spatial.selection import select_k, merge_topk, chunk_min_select_k
-from raft_tpu.spatial.fused_knn import fused_l2_knn, fused_knn_supported
+from raft_tpu.spatial.fused_knn import (
+    fused_grid_ok, fused_l2_knn, fused_knn_supported,
+)
 
 __all__ = [
     "brute_force_knn",
@@ -154,6 +156,8 @@ def brute_force_knn(
     block_q: Optional[int] = None,
     exact: bool = True,
     use_fused: Optional[bool] = None,
+    compute_dtype=None,
+    extra_chunks: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Brute-force kNN over one or more index partitions.
 
@@ -166,7 +170,11 @@ def brute_force_knn(
     fused Pallas chunk-min kernel (:mod:`raft_tpu.spatial.fused_knn`, the
     analog of the reference's fused_l2_knn.cuh fast path, measured 13x the
     scan path at SIFT-1M shape); other metrics/shapes take the streaming
-    scan path.
+    scan path. ``compute_dtype``/``extra_chunks`` tune the fused path
+    (fused_l2_knn docs); ``compute_dtype=bfloat16`` with bf16 partitions
+    is the HBM-resident big-index mode — partitioning also keeps each
+    Pallas grid under the compiler's step limit, so a ~14 GB index runs
+    as 3-4 bf16 partitions (the 10M x 768 BASELINE regime).
 
     Returns (distances (m, k), indices (m, k)), best-first.
     """
@@ -203,6 +211,7 @@ def brute_force_knn(
             use_fused is None
             and fused_ok
             and n >= 65536
+            and fused_grid_ok(m, n, d)  # else fall back to the scan path
             and jax.default_backend() == "tpu"
         ):
             if not fused_ok:
@@ -210,7 +219,18 @@ def brute_force_knn(
                     f"use_fused=True but fused path unsupported for "
                     f"metric={metric} m={m} n={n} d={d} k={k} exact={exact}"
                 )
-            return fused_l2_knn(queries, pt, k, metric=metric)
+            kw = {}
+            if compute_dtype is not None:
+                kw["compute_dtype"] = compute_dtype
+            if extra_chunks is not None:
+                kw["extra_chunks"] = extra_chunks
+            return fused_l2_knn(queries, pt, k, metric=metric, **kw)
+        errors.expects(
+            compute_dtype is None and extra_chunks is None,
+            "compute_dtype/extra_chunks tune the fused path only, but the "
+            "%d-row partition routed to the scan path; pass use_fused=True "
+            "to force fused, or drop the tuning args", n,
+        )
         return _knn_single_part(
             queries, pt, k, metric, p, block_n, block_q, exact
         )

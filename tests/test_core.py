@@ -106,6 +106,56 @@ class TestInterruptible:
         x = jax.numpy.ones((8,))
         Interruptible.synchronize(x)
 
+    def test_synchronize_interrupts_in_flight_wait(self):
+        """cancel() from another thread must break a wait on still-running
+        device work (the reference's polling-loop guarantee,
+        interruptible.hpp:66-120) — not just a wait that hasn't started."""
+        import threading
+        import time as _time
+
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def slow(a, n):
+            def body(i, acc):
+                return acc @ a / jnp.float32(1.0001)
+            return lax.fori_loop(0, n, body, a)
+
+        a = jnp.eye(400) * 1.001
+        jax.block_until_ready(slow(a, 2))  # compile
+
+        out = slow(a, 8_000)  # dispatched; runs for several seconds
+        state = {}
+        started = threading.Event()
+
+        def waiter():
+            started.set()
+            t0 = _time.perf_counter()
+            try:
+                Interruptible.synchronize(out)
+                state["result"] = "completed"
+            except InterruptedException:
+                state["result"] = "interrupted"
+            state["elapsed"] = _time.perf_counter() - t0
+
+        tid_holder = []
+
+        def run():
+            tid_holder.append(threading.get_ident())
+            waiter()
+
+        t = threading.Thread(target=run)
+        t.start()
+        started.wait()
+        _time.sleep(0.3)  # let the wait become in-flight
+        Interruptible.cancel_thread(tid_holder[0])
+        t.join(timeout=10)
+        assert state.get("result") == "interrupted", state
+        assert state["elapsed"] < 8.0, state  # broke out of the wait
+        # drain the still-running dispatch so it cannot outlive the test
+        jax.block_until_ready(out)
+
 
 class TestAnnotate:
     def test_context(self):

@@ -95,3 +95,43 @@ def test_brute_force_knn_use_fused_unsupported_raises(rng_np):
     x = rng_np.standard_normal((256, 16)).astype(np.float32)
     with pytest.raises(ValueError):
         brute_force_knn(x, q, 3, use_fused=True)  # n too small for cover
+
+
+def test_fused_knn_row_gather_matches_chunk_gather(rng_np):
+    """The big-index phase-2 row-gather branch (taken automatically above
+    2 GB, forced here) must agree exactly with the chunk-gather branch."""
+    q = rng_np.standard_normal((37, 24)).astype(np.float32)
+    y = rng_np.standard_normal((4096 + 57, 24)).astype(np.float32)
+    d1, i1 = fused_l2_knn(q, y, 7, gather_rows=False)
+    d2, i2 = fused_l2_knn(q, y, 7, gather_rows=True)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_fused_knn_aligned_index_no_pad(rng_np):
+    """Exact-multiple index rows skip the pad copy (big-index regime);
+    results must still match the brute-force oracle."""
+    q = rng_np.standard_normal((16, 32)).astype(np.float32)
+    y = rng_np.standard_normal((8192, 32)).astype(np.float32)
+    d1, i1 = fused_l2_knn(q, y, 5, bn=2048)
+    full = ((q[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    want_i = np.argsort(full, 1, kind="stable")[:, :5]
+    want_d = np.sqrt(np.take_along_axis(full, want_i, 1))
+    np.testing.assert_allclose(np.asarray(d1), want_d, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_knn_warm_start(rng_np):
+    """Warm-starting partition B's search with partition A's (translated)
+    results equals one search over A + B (the reference's previous-top-k
+    warm path, fused_l2_knn.cuh:947)."""
+    q = rng_np.standard_normal((23, 16)).astype(np.float32)
+    a = rng_np.standard_normal((4096, 16)).astype(np.float32)
+    b = rng_np.standard_normal((4096, 16)).astype(np.float32)
+    k = 6
+    da, ia = fused_l2_knn(q, a, k)
+    db, ib = fused_l2_knn(q, b, k, init=(da, ia + 0))  # a-ids are global
+    dfull, ifull = fused_l2_knn(q, np.concatenate([b, a]), k)
+    # translate: b ids 0..4095 stay, a ids offset by 4096 in the concat
+    got = np.sort(np.asarray(db), axis=1)
+    want = np.sort(np.asarray(dfull), axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
