@@ -27,7 +27,7 @@ import jax
 from bench.common import bench_fn
 from raft_tpu.spatial.ann import (
     IVFFlatParams, ivf_flat_build, ivf_flat_search, ivf_flat_search_grouped,
-    IVFPQParams, ivf_pq_build, ivf_pq_search,
+    IVFPQParams, ivf_pq_build, ivf_pq_search, ivf_pq_search_grouped,
 )
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.spatial.fused_knn import fused_l2_knn
@@ -128,6 +128,25 @@ def main():
                       "recall_at_10": round(r, 4)})
     print(json.dumps({"name": f"ann/ivf_pq_sweep_q32/{n}x{d}",
                       "refine_ratio": 4.0, "sweep": sweep}))
+
+    # grouped (list-major) PQ throughput mode: one-hot ADC matmul on the
+    # MXU instead of per-candidate LUT gathers
+    for nprobe in (8, 16):
+        ms = bench_fn(
+            lambda a: ivf_pq_search_grouped(
+                index=pq, queries=a, k=k, n_probes=nprobe,
+                refine_ratio=4.0, qcap=256,
+            )[0],
+            q_big, iters=4,
+            name=f"ann/ivf_pq_grouped_p{nprobe}/{n}x{d}q{nq}")
+        r = recall_at_k(
+            ivf_pq_search_grouped(pq, q_big, k, n_probes=nprobe,
+                                  refine_ratio=4.0, qcap=256)[1],
+            true_big)
+        print(json.dumps({
+            "name": f"ann/ivf_pq_grouped_p{nprobe}/{n}x{d}",
+            "qps": round(nq / (ms / 1e3)), "recall_at_10": round(r, 4),
+        }))
 
 
 if __name__ == "__main__":

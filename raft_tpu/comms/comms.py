@@ -248,21 +248,24 @@ class P2PBatch:
             # greedy rounds: within a round every src and dst is unique
             remaining = list(entries)
             while remaining:
+                # a round = unique sources, unique destinations, AND one
+                # (shape, dtype) — a ppermute carries a single payload
+                # type, so mixed-shape transfers split into further rounds
                 round_entries, used_s, used_d, rest = [], set(), set(), []
+                round_sig = None
                 for s, d, v in remaining:
-                    if s in used_s or d in used_d:
+                    sig = (v.shape, v.dtype.name)
+                    if (
+                        s in used_s or d in used_d
+                        or (round_sig is not None and sig != round_sig)
+                    ):
                         rest.append((s, d, v))
                     else:
                         round_entries.append((s, d, v))
                         used_s.add(s)
                         used_d.add(d)
+                        round_sig = sig
                 remaining = rest
-                shapes = {(v.shape, v.dtype.name) for _, _, v in round_entries}
-                errors.expects(
-                    len(shapes) == 1,
-                    "p2p: one ppermute round needs uniform shapes, got %s",
-                    sorted(shapes),
-                )
                 # each rank contributes the value of ITS send in this round
                 payload = sum(
                     jnp.where(rank == s, v, jnp.zeros_like(v))

@@ -17,6 +17,7 @@ from raft_tpu.spatial.ann.ivf_pq import (
     IVFPQIndex,
     ivf_pq_build,
     ivf_pq_search,
+    ivf_pq_search_grouped,
 )
 from raft_tpu.spatial.ann.ivf_sq import (
     IVFSQParams,
@@ -36,6 +37,7 @@ __all__ = [
     "IVFFlatParams", "IVFFlatIndex", "ivf_flat_build", "ivf_flat_search",
     "ivf_flat_search_grouped",
     "IVFPQParams", "IVFPQIndex", "ivf_pq_build", "ivf_pq_search",
+    "ivf_pq_search_grouped",
     "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
     "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",
 ]

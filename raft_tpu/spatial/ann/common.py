@@ -106,6 +106,52 @@ def map_query_blocks(fn, queries, block_q: int):
     )
 
 
+def invert_probe_map(probes, n_lists: int, qcap: int):
+    """Invert a (nq, p) query→list probe map into a list→queries matrix —
+    the shared first step of every LIST-MAJOR (grouped, throughput-mode)
+    IVF search (SURVEY.md §7 hard part №3 "sorted-by-list batching").
+
+    Returns (qmat (n_lists, qcap) int32 padded with nq,
+             l_flat (nq*p,) the probed list of each (query, probe) pair,
+             slot (nq*p,) that pair's row in qmat — >= qcap if dropped).
+    """
+    nq, p = probes.shape
+    l_flat = probes.reshape(-1)                              # (nq*p,)
+    q_flat = jnp.repeat(jnp.arange(nq, dtype=jnp.int32), p)
+    order = jnp.argsort(l_flat, stable=True)
+    sl = l_flat[order]
+    sq = q_flat[order]
+    starts = jnp.searchsorted(sl, jnp.arange(n_lists, dtype=sl.dtype))
+    slot_sorted = (
+        jnp.arange(nq * p, dtype=jnp.int32) - starts[sl].astype(jnp.int32)
+    )
+    qmat = jnp.full((n_lists, qcap), nq, jnp.int32).at[
+        sl, slot_sorted
+    ].set(sq, mode="drop")                                   # (n_lists, qcap)
+    slot = jnp.zeros((nq * p,), jnp.int32).at[order].set(slot_sorted)
+    return qmat, l_flat, slot
+
+
+def regroup_pairs(vals, mem, l_flat, slot, nq: int, p: int, qcap: int):
+    """Redistribute per-(list, query-slot) top-k results back to
+    query-major order: (n_lists, qcap, k) -> (nq, p*k) candidate pool
+    (+inf where the pair overflowed qcap) — the shared tail of grouped
+    searches."""
+    k = vals.shape[-1]
+    ok = slot < qcap
+    safe_slot = jnp.minimum(slot, qcap - 1)
+    pv = jnp.where(ok[:, None], vals[l_flat, safe_slot], jnp.inf)
+    pm = mem[l_flat, safe_slot]
+    return pv.reshape(nq, p * k), pm.reshape(nq, p * k)
+
+
+def default_qcap(nq: int, n_probes: int, n_lists: int) -> int:
+    """2x the mean per-list probe occupancy, 8-aligned (the grouped
+    searches' default static queries-per-list cap)."""
+    mean_occ = max(1, (nq * n_probes + n_lists - 1) // n_lists)
+    return min(nq, -(-2 * mean_occ // 8) * 8)
+
+
 def check_candidate_pool(k: int, n_probes: int, storage: ListStorage):
     if k > n_probes * storage.max_list:
         raise ValueError(

@@ -122,27 +122,12 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block):
     f32 = jnp.float32
     qf = q.astype(f32)
 
-    from raft_tpu.spatial.ann.common import coarse_probe
+    from raft_tpu.spatial.ann.common import coarse_probe, invert_probe_map
 
     probes, _ = coarse_probe(qf, index.centroids, p)         # (nq, p)
-
     # invert the probe map: for each list, the (padded) set of queries
-    # probing it. Pairs sorted by list id; position within the group is the
-    # query's slot in that list's row.
-    l_flat = probes.reshape(-1)                              # (nq*p,)
-    q_flat = jnp.repeat(jnp.arange(nq, dtype=jnp.int32), p)
-    order = jnp.argsort(l_flat, stable=True)
-    sl = l_flat[order]
-    sq = q_flat[order]
-    starts = jnp.searchsorted(sl, jnp.arange(n_lists, dtype=sl.dtype))
-    slot_sorted = (
-        jnp.arange(nq * p, dtype=jnp.int32) - starts[sl].astype(jnp.int32)
-    )
-    qmat = jnp.full((n_lists, qcap), nq, jnp.int32).at[
-        sl, slot_sorted
-    ].set(sq, mode="drop")                                   # (n_lists, qcap)
-    # slot of each pair in ORIGINAL (query-major) order, for result gather
-    slot = jnp.zeros((nq * p,), jnp.int32).at[order].set(slot_sorted)
+    # probing it (shared grouped-search machinery, common.py)
+    qmat, l_flat, slot = invert_probe_map(probes, n_lists, qcap)
 
     q_pad = jnp.concatenate([qf, jnp.zeros((1, d), f32)])    # sentinel query
     qn_pad = jnp.concatenate(
@@ -177,12 +162,9 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block):
     mem = mem.reshape(n_lists, qcap, k)
 
     # per-pair result gather (original query-major order), then final k
-    ok = slot < qcap
-    safe_slot = jnp.minimum(slot, qcap - 1)
-    pv = jnp.where(ok[:, None], vals[l_flat, safe_slot], jnp.inf)
-    pm = mem[l_flat, safe_slot]
-    pv = pv.reshape(nq, p * k)
-    pm = pm.reshape(nq, p * k)
+    from raft_tpu.spatial.ann.common import regroup_pairs
+
+    pv, pm = regroup_pairs(vals, mem, l_flat, slot, nq, p, qcap)
     fvals, fpos = lax.top_k(-pv, k)
     fmem = jnp.take_along_axis(pm, fpos, axis=1)
     ids = storage.sorted_ids[jnp.clip(fmem, 0, storage.n - 1)]
@@ -224,8 +206,9 @@ def ivf_flat_search_grouped(
         raise ValueError("k exceeds candidate pool; raise n_probes")
     n_lists = storage.list_index.shape[0]
     if qcap is None:
-        mean_occ = max(1, (nq * n_probes + n_lists - 1) // n_lists)
-        qcap = min(nq, _round_up8(2 * mean_occ))
+        from raft_tpu.spatial.ann.common import default_qcap
+
+        qcap = default_qcap(nq, n_probes, n_lists)
     list_block = max(1, min(list_block, n_lists))
     while n_lists % list_block:
         list_block -= 1
@@ -233,7 +216,3 @@ def ivf_flat_search_grouped(
     if index.metric == "l2":
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))
     return vals, ids
-
-
-def _round_up8(v: int) -> int:
-    return -(-v // 8) * 8

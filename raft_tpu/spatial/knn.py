@@ -224,18 +224,23 @@ def brute_force_knn(
                 kw["compute_dtype"] = compute_dtype
             if extra_chunks is not None:
                 kw["extra_chunks"] = extra_chunks
-            return fused_l2_knn(queries, pt, k, metric=metric, **kw)
-        errors.expects(
-            compute_dtype is None and extra_chunks is None,
-            "compute_dtype/extra_chunks tune the fused path only, but the "
-            "%d-row partition routed to the scan path; pass use_fused=True "
-            "to force fused, or drop the tuning args", n,
-        )
+            return fused_l2_knn(queries, pt, k, metric=metric, **kw), True
         return _knn_single_part(
             queries, pt, k, metric, p, block_n, block_q, exact
-        )
+        ), False
 
-    results = [_search_part(pt) for pt in parts]
+    searched = [_search_part(pt) for pt in parts]
+    results = [r for r, _ in searched]
+    # fused tuning args must not be dropped SILENTLY: error only when no
+    # partition took the fused path (mixed partition sets legitimately
+    # route small tails to the scan path while the args apply to the rest)
+    errors.expects(
+        (compute_dtype is None and extra_chunks is None)
+        or any(fused for _, fused in searched),
+        "compute_dtype/extra_chunks tune the fused path, but every "
+        "partition routed to the scan path; pass use_fused=True to force "
+        "fused, or drop the tuning args",
+    )
     if len(parts) == 1:
         d0, i0 = results[0]
         return d0, i0 + jnp.int32(offs[0])

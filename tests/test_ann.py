@@ -164,3 +164,33 @@ def test_ball_cover_all_knn(dataset):
     d, i, exact = rbc_all_knn_query(index, 4, n_probes=10)
     # each point's nearest neighbor is itself
     np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(len(x)))
+
+
+def test_ivf_pq_grouped_matches_per_query_recall(dataset):
+    """List-major grouped PQ search (one-hot ADC matmul) must reach the
+    per-query path's recall at the same n_probes/refine settings."""
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
+
+    x, q = dataset
+    pq = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=8))
+    bd, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    _, i1 = ivf_pq_search(pq, q, 10, n_probes=8, refine_ratio=4.0)
+    _, i2 = ivf_pq_search_grouped(
+        pq, q, 10, n_probes=8, refine_ratio=4.0, qcap=q.shape[0]
+    )
+    r1 = recall(np.asarray(i1), np.asarray(bi))
+    r2 = recall(np.asarray(i2), np.asarray(bi))
+    assert r2 >= r1 - 0.05, (r1, r2)
+    assert r2 > 0.85, r2
+
+
+def test_ivf_pq_grouped_unrefined(dataset):
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
+
+    x, q = dataset
+    pq = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=8))
+    bd, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    _, ids = ivf_pq_search_grouped(
+        pq, q, 10, n_probes=8, refine_ratio=0.0, qcap=q.shape[0]
+    )
+    assert recall(np.asarray(ids), np.asarray(bi)) > 0.5

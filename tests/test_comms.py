@@ -151,3 +151,30 @@ def test_p2p_batch_unmatched_raises(comms):
     x = jnp.ones((8, 1), jnp.float32)
     out = comms.shard_map(body, in_specs=P("ranks"), out_specs=P("ranks"))(x)
     assert np.asarray(out).sum() == 8.0
+
+
+def test_p2p_batch_mixed_shapes(comms):
+    """Transfers with different shapes under one tag split into separate
+    ppermute rounds instead of erroring (the reference's tagged p2p has no
+    same-size requirement across endpoint pairs)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        c = comms.device_comms()
+        p2p = c.p2p_batch()
+        wide = jnp.concatenate([x, x], axis=-1)      # (1, 2) per rank
+        p2p.isend(x, src=0, dest=1, tag=0)           # (1, 1)
+        p2p.irecv(src=0, dest=1, tag=0)
+        p2p.isend(wide, src=2, dest=3, tag=0)        # (1, 2) — new round
+        p2p.irecv(src=2, dest=3, tag=0)
+        got = p2p.waitall()
+        return got[(0, 1, 0)] + got[(2, 3, 0)][:, :1]
+
+    x = jnp.arange(1, 9, dtype=jnp.float32).reshape(8, 1)
+    out = np.asarray(
+        comms.shard_map(body, in_specs=P("ranks"), out_specs=P("ranks"))(x)
+    )
+    assert out[1, 0] == 1.0   # rank 0's value at rank 1
+    assert out[3, 0] == 3.0   # rank 2's value at rank 3
+    assert out[0, 0] == 0.0 and out[2, 0] == 0.0

@@ -131,3 +131,26 @@ def test_epsilon_neighborhood(rng_np):
     want = d2 <= eps**2
     np.testing.assert_array_equal(np.asarray(adj), want)
     np.testing.assert_array_equal(np.asarray(vd), want.sum(1))
+
+
+def test_brute_force_knn_mixed_partitions_with_tuning_args(rng_np):
+    """compute_dtype on a mixed partition set must not raise while any
+    partition takes the fused path; it must raise when none does."""
+    import jax.numpy as jnp
+    import pytest
+    from raft_tpu import errors
+
+    q = rng_np.standard_normal((8, 16)).astype(np.float32)
+    small = rng_np.standard_normal((500, 16)).astype(np.float32)
+    with pytest.raises(errors.RaftException):
+        # all partitions scan-routed (CPU backend, tiny n): args dropped
+        brute_force_knn(
+            [small, small], q, 3, compute_dtype=jnp.bfloat16,
+        )
+    # forcing fused consumes the args without raising
+    big = rng_np.standard_normal((8192, 16)).astype(np.float32)
+    d, i = brute_force_knn(
+        [big], q, 3, metric="sqeuclidean", use_fused=True,
+        compute_dtype=jnp.float32,
+    )
+    assert d.shape == (8, 3)
