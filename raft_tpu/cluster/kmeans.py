@@ -79,9 +79,12 @@ def _update_centroids(x, labels, k: int, block_rows: int):
     def body(carry, blk):
         sums, counts = carry
         xb, lb = blk
-        oh = jax.nn.one_hot(lb, k, dtype=x.dtype)          # (bm, k)
+        # bf16 operands, f32 accumulation: 2x MXU rate; the 0.4%-relative
+        # operand rounding averages out over each cluster's members (the
+        # assign step already runs its gram at the same precision)
+        oh = jax.nn.one_hot(lb, k, dtype=jnp.bfloat16)     # (bm, k)
         sums = sums + lax.dot_general(
-            oh, xb, (((0,), (0,)), ((), ())),
+            oh, xb.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         counts = counts + jnp.sum(oh, axis=0, dtype=jnp.float32)
@@ -131,12 +134,13 @@ def _lloyd(x, cents0, k: int, max_iter: int, tol: float, block_rows: int):
         # HIGHEST-precision gram; borderline mis-assignments are benign in
         # lloyd iterations (and vanish as centroids converge)
         minv, mini = fused_l2_nn(x, cents, precision="default")
-        return mini, jnp.sum(minv)
+        return mini, minv
 
-    def reseed_empty(cents, counts, key):
+    def reseed_empty(cents, counts, minv):
         # empty-cluster handling (reference :882-896): move empty centroids
         # onto the points currently farthest from their assigned centroid.
-        minv, _ = fused_l2_nn(x, cents)
+        # ``minv`` is REUSED from this iteration's assignment — recomputing
+        # it here would cost a third full (m, k, d) pass per iteration.
         far = jnp.argsort(-minv)  # farthest points first
         empty_rank = jnp.cumsum(counts == 0) - 1  # rank among empties
         take = jnp.where(counts == 0, far[jnp.clip(empty_rank, 0, m - 1)], 0)
@@ -145,24 +149,29 @@ def _lloyd(x, cents0, k: int, max_iter: int, tol: float, block_rows: int):
         )
 
     def cond(state):
-        it, _, prev_res, res, _ = state
+        it, _, prev_res, res = state
         return (it < max_iter) & (jnp.abs(prev_res - res) / m > tol)
 
     def step(state):
-        it, cents, _, res, labels = state
-        labels, _ = assign(cents)
+        # ONE assignment per iteration yields both the labels and the
+        # residual of the current centroids (the reference's
+        # assignCentroids + cub reduce single pass, detail/kmeans.cuh:565)
+        # — an assign/update/re-assign structure would pay a third full
+        # (m, k, d) pass per iteration just to refresh the residual.
+        it, cents, _, res = state
+        labels, minv = assign(cents)
         sums, counts = _update_centroids(x, labels, k, block_rows)
         new_cents = sums / jnp.maximum(counts, 1.0)[:, None]
         new_cents = new_cents.astype(x.dtype)
-        new_cents = reseed_empty(new_cents, counts, None)
-        _, new_res = assign(new_cents)
-        return it + 1, new_cents, res, new_res, labels
+        new_cents = reseed_empty(new_cents, counts, minv)
+        return it + 1, new_cents, res, jnp.sum(minv)
 
-    labels0, res0 = assign(cents0)
-    state = (jnp.int32(0), cents0, jnp.float32(jnp.inf), res0, labels0)
-    it, cents, _, res, _ = lax.while_loop(cond, step, state)
-    labels, res = assign(cents)
-    return KMeansOutput(cents, labels.astype(jnp.int32), res, it)
+    # prev=-inf, res=+inf: first two cond checks see an inf difference
+    # (a nan from inf-inf would end the loop before it starts)
+    state = (jnp.int32(0), cents0, jnp.float32(-jnp.inf), jnp.float32(jnp.inf))
+    it, cents, _, _ = lax.while_loop(cond, step, state)
+    labels, minv = assign(cents)
+    return KMeansOutput(cents, labels.astype(jnp.int32), jnp.sum(minv), it)
 
 
 def kmeans_fit(

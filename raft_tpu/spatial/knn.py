@@ -203,7 +203,7 @@ def brute_force_knn(
     else:
         offs = list(translations)
 
-    def _search_part(pt):
+    def _routes_fused(pt) -> bool:
         m, d = queries.shape
         n = pt.shape[0]
         fused_ok = exact and fused_knn_supported(metric, m, n, d, k)
@@ -219,28 +219,35 @@ def brute_force_knn(
                     f"use_fused=True but fused path unsupported for "
                     f"metric={metric} m={m} n={n} d={d} k={k} exact={exact}"
                 )
+            return True
+        return False
+
+    routes = [_routes_fused(pt) for pt in parts]
+    # fused tuning args must not be dropped SILENTLY: error only when no
+    # partition takes the fused path (mixed partition sets legitimately
+    # route small tails to the scan path while the args apply to the
+    # rest). Checked BEFORE any search runs — not after paying for the
+    # full dispatch.
+    errors.expects(
+        (compute_dtype is None and extra_chunks is None) or any(routes),
+        "compute_dtype/extra_chunks tune the fused path, but every "
+        "partition routed to the scan path; pass use_fused=True to force "
+        "fused, or drop the tuning args",
+    )
+
+    def _search_part(pt, fused):
+        if fused:
             kw = {}
             if compute_dtype is not None:
                 kw["compute_dtype"] = compute_dtype
             if extra_chunks is not None:
                 kw["extra_chunks"] = extra_chunks
-            return fused_l2_knn(queries, pt, k, metric=metric, **kw), True
+            return fused_l2_knn(queries, pt, k, metric=metric, **kw)
         return _knn_single_part(
             queries, pt, k, metric, p, block_n, block_q, exact
-        ), False
+        )
 
-    searched = [_search_part(pt) for pt in parts]
-    results = [r for r, _ in searched]
-    # fused tuning args must not be dropped SILENTLY: error only when no
-    # partition took the fused path (mixed partition sets legitimately
-    # route small tails to the scan path while the args apply to the rest)
-    errors.expects(
-        (compute_dtype is None and extra_chunks is None)
-        or any(fused for _, fused in searched),
-        "compute_dtype/extra_chunks tune the fused path, but every "
-        "partition routed to the scan path; pass use_fused=True to force "
-        "fused, or drop the tuning args",
-    )
+    results = [_search_part(pt, f) for pt, f in zip(parts, routes)]
     if len(parts) == 1:
         d0, i0 = results[0]
         return d0, i0 + jnp.int32(offs[0])
