@@ -39,6 +39,7 @@ __all__ = [
     "KMeansOutput",
     "kmeans_plus_plus_init",
     "kmeans_fit",
+    "kmeans_fit_batched",
     "kmeans_predict",
     "kmeans_transform",
     "kmeans",
@@ -209,6 +210,43 @@ def kmeans_fit(
         x, cents0, params.n_clusters, params.max_iter, params.tol,
         params.block_rows,
     )
+
+
+def kmeans_fit_batched(xs, params: Optional[KMeansParams] = None, **kw):
+    """Fit B independent k-means problems of identical shape in ONE
+    vmapped program — the batched entry point for callers like IVF-PQ
+    codebook training (M subspaces of equal shape), where B sequential
+    fits of skinny problems underfill the MXU and pay B dispatches.
+
+    ``xs``: (B, n, d). Returns a :class:`KMeansOutput` with leading batch
+    axis on every leaf. Seeds derive from ``params.seed`` per problem.
+    Requires n >= n_clusters (no per-problem padding in the batched path).
+    """
+    if params is None:
+        params = KMeansParams(**kw)
+    xs = jnp.asarray(xs)
+    errors.check_matrix(xs, "xs", ndim=3)
+    B, n, d = xs.shape
+    errors.check_k(params.n_clusters, n, "n_clusters vs n rows")
+    keys = jax.random.split(jax.random.PRNGKey(params.seed), B)
+    if params.init == "random":
+        def pick(key):
+            return jax.random.choice(
+                key, n, (params.n_clusters,), replace=False
+            )
+
+        idxs = jax.vmap(pick)(keys)
+        cents0 = jnp.take_along_axis(xs, idxs[:, :, None], axis=1)
+    else:
+        cents0 = jax.vmap(
+            lambda x, k_: kmeans_plus_plus_init(x, params.n_clusters, k_)
+        )(xs, keys)
+    return jax.vmap(
+        lambda x, c0: _lloyd(
+            x, c0, params.n_clusters, params.max_iter, params.tol,
+            params.block_rows,
+        )
+    )(xs, cents0)
 
 
 def kmeans_predict(x, centroids):

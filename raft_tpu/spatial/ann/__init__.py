@@ -25,6 +25,7 @@ from raft_tpu.spatial.ann.ivf_sq import (
     ivf_sq_build,
     ivf_sq_search,
 )
+from raft_tpu.spatial.ann.serialize import save_index, load_index
 from raft_tpu.spatial.ann.ball_cover import (
     BallCoverIndex,
     rbc_build_index,
@@ -40,4 +41,5 @@ __all__ = [
     "ivf_pq_search_grouped",
     "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
     "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",
+    "save_index", "load_index",
 ]

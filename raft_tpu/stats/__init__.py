@@ -6,6 +6,8 @@ criteria, trustworthiness.
 
 from raft_tpu.stats.summary import (
     mean,
+    mean_center,
+    mean_add,
     stddev,
     vars_,
     meanvar,

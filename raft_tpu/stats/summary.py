@@ -17,6 +17,8 @@ from jax import lax
 
 __all__ = [
     "mean",
+    "mean_center",
+    "mean_add",
     "stddev",
     "vars_",
     "meanvar",
@@ -127,3 +129,22 @@ def row_weighted_mean(x, weights):
 def col_weighted_mean(x, weights):
     """Per-column mean weighted across rows (colWeightedMean)."""
     return weighted_mean(jnp.asarray(x), weights, axis=0)
+
+
+def mean_center(x, mu=None, *, axis: int = 0):
+    """Subtract per-axis means (reference stats/mean_center.cuh:42
+    ``meanCenter``; ``axis=0`` centers columns = bcastAlongRows). ``mu``
+    defaults to ``mean(x, axis)``."""
+    x = jnp.asarray(x)
+    if mu is None:
+        mu = mean(x, axis=axis)
+    mu = jnp.asarray(mu)
+    return x - (mu[None, :] if axis == 0 else mu[:, None])
+
+
+def mean_add(x, mu, *, axis: int = 0):
+    """Add per-axis means back (reference stats/mean_center.cuh:69
+    ``meanAdd`` — the inverse of :func:`mean_center`)."""
+    x = jnp.asarray(x)
+    mu = jnp.asarray(mu)
+    return x + (mu[None, :] if axis == 0 else mu[:, None])

@@ -194,3 +194,58 @@ def test_ivf_pq_grouped_unrefined(dataset):
         pq, q, 10, n_probes=8, refine_ratio=0.0, qcap=q.shape[0]
     )
     assert recall(np.asarray(ids), np.asarray(bi)) > 0.5
+
+
+def test_index_serialization_roundtrip(tmp_path, dataset):
+    """save_index/load_index roundtrip for every index family: identical
+    search results after reload (the reference keeps FAISS indexes
+    memory-only; persistence is native here)."""
+    from raft_tpu.spatial.ann import save_index, load_index
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
+
+    import jax.numpy as jnp
+
+    x, q = dataset
+    pq = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=6))
+    flat = ivf_flat_build(x, IVFFlatParams(n_lists=16, kmeans_n_iters=6))
+    sq = ivf_sq_build(x, IVFSQParams(n_lists=16, kmeans_n_iters=6))
+    # bf16 storage must round-trip too (ml_dtypes arrays need the bit-view
+    # path — raw np.savez of bfloat16 stores void bytes that cannot load)
+    flat16 = ivf_flat_build(
+        x.astype(jnp.bfloat16), IVFFlatParams(n_lists=16, kmeans_n_iters=6)
+    )
+    for name, idx, search in [
+        ("flat", flat, lambda i: ivf_flat_search(i, q, 5, n_probes=4)),
+        ("flat_bf16", flat16, lambda i: ivf_flat_search(i, q, 5, n_probes=4)),
+        ("sq", sq, lambda i: ivf_sq_search(i, q, 5, n_probes=4)),
+        ("pq", pq, lambda i: ivf_pq_search(i, q, 5, n_probes=4)),
+        ("pq_grouped", pq,
+         lambda i: ivf_pq_search_grouped(i, q, 5, n_probes=4, qcap=64)),
+    ]:
+        path = tmp_path / f"{name}.npz"
+        save_index(idx, path)
+        loaded = load_index(path)
+        d0, i0 = search(idx)
+        d1, i1 = search(loaded)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1),
+                                      err_msg=name)
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-6, err_msg=name)
+
+
+def test_sparse_colblock_index_serialization(tmp_path, rng_np):
+    from raft_tpu.spatial.ann import save_index, load_index
+    from raft_tpu.sparse import csr_from_scipy, sparse_brute_force_knn
+    from raft_tpu.sparse.distance import sparse_colblock_index_build
+    from tests.test_sparse import _scipy_rand
+
+    idx_sp = _scipy_rand(rng_np, 300, 20_000, 30)
+    qry = csr_from_scipy(_scipy_rand(rng_np, 50, 20_000, 30))
+    layout = sparse_colblock_index_build(idx_sp, 4096)
+    path = tmp_path / "sparse.npz"
+    save_index(layout, path)
+    loaded = load_index(path)
+    d0, i0 = sparse_brute_force_knn(layout, qry, 5, metric="sqeuclidean")
+    d1, i1 = sparse_brute_force_knn(loaded, qry, 5, metric="sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)

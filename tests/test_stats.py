@@ -209,3 +209,17 @@ def test_trustworthiness_perfect_embedding(rng_np):
     # random embedding scores lower
     bad = rng_np.standard_normal((60, 2)).astype(np.float32)
     assert float(stats.trustworthiness_score(x, bad, n_neighbors=5)) < 0.95
+
+
+def test_mean_center_and_add(rng_np):
+    from raft_tpu.stats import mean_center, mean_add, mean
+
+    x = rng_np.standard_normal((20, 7)).astype(np.float32)
+    c = np.asarray(mean_center(x))
+    np.testing.assert_allclose(c.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(c, x - x.mean(0, keepdims=True), rtol=1e-5)
+    back = np.asarray(mean_add(c, mean(x, axis=0)))
+    np.testing.assert_allclose(back, x, rtol=1e-5, atol=1e-6)
+    # row centering (bcastAlongRows=False analog)
+    cr = np.asarray(mean_center(x, axis=1))
+    np.testing.assert_allclose(cr.mean(axis=1), 0.0, atol=1e-5)
