@@ -228,6 +228,9 @@ def kmeans_fit_batched(xs, params: Optional[KMeansParams] = None, **kw):
     errors.check_matrix(xs, "xs", ndim=3)
     B, n, d = xs.shape
     errors.check_k(params.n_clusters, n, "n_clusters vs n rows")
+    errors.expects(
+        params.max_iter >= 1, "max_iter must be >= 1, got %d", params.max_iter
+    )
     keys = jax.random.split(jax.random.PRNGKey(params.seed), B)
     if params.init == "random":
         def pick(key):

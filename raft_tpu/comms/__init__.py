@@ -10,9 +10,11 @@ tagged p2p.
 from raft_tpu.comms.comms import (
     AxisComms,
     Comms,
+    HierarchicalComms,
     P2PBatch,
     ReduceOp,
     build_comms,
+    build_comms_hierarchical,
     inject_comms,
 )
 from raft_tpu.comms import self_test
@@ -23,7 +25,9 @@ from raft_tpu.comms.ring import ring_knn, ring_pairwise_distance
 __all__ = [
     "AxisComms",
     "Comms",
+    "HierarchicalComms",
     "P2PBatch",
+    "build_comms_hierarchical",
     "ReduceOp",
     "build_comms",
     "inject_comms",

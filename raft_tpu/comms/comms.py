@@ -40,7 +40,10 @@ from jax import lax
 
 from raft_tpu import errors
 
-__all__ = ["ReduceOp", "AxisComms", "P2PBatch", "Comms", "build_comms", "inject_comms"]
+__all__ = [
+    "ReduceOp", "AxisComms", "P2PBatch", "Comms", "HierarchicalComms",
+    "build_comms", "build_comms_hierarchical", "inject_comms",
+]
 
 
 class ReduceOp(enum.Enum):
@@ -363,9 +366,80 @@ class Comms:
         )
 
 
+class HierarchicalComms(Comms):
+    """Two-level communicator over an (outer, inner) device mesh — the
+    multi-host topology: ``inner`` = chips within a slice (ICI), ``outer``
+    = across hosts/slices (DCN). The reference reaches the same shape by
+    nesting NCCL communicators via ``comm_split`` (std_comms.hpp:144-180);
+    here both levels are axes of one ``jax.sharding.Mesh`` and XLA routes
+    each collective over the matching interconnect.
+
+    ``device_comms()`` (both axes at once), :meth:`inner_comms`, and
+    :meth:`outer_comms` are all usable inside one ``shard_map`` over the
+    2D mesh.
+    """
+
+    def __init__(self, devices=None, mesh_shape=None, axes=("dcn", "ici")):
+        devs = np.array(list(devices) if devices is not None else jax.devices())
+        if mesh_shape is None:
+            mesh_shape = (1, devs.size)
+        errors.expects(
+            len(mesh_shape) == len(axes),
+            "mesh_shape %s must have one dim per axis %s", mesh_shape, axes,
+        )
+        errors.expects(
+            int(np.prod(mesh_shape)) == devs.size,
+            "mesh_shape %s needs %d devices, got %d",
+            mesh_shape, int(np.prod(mesh_shape)), devs.size,
+        )
+        self.mesh = jax.sharding.Mesh(devs.reshape(mesh_shape), axes)
+        self.axes = tuple(axes)
+        self.axis = self.axes  # collectives over BOTH levels by default
+
+    def inner_comms(self) -> AxisComms:
+        """Collectives within a slice (ICI-routed)."""
+        return AxisComms(self.axes[1])
+
+    def outer_comms(self) -> AxisComms:
+        """Collectives across slices (DCN-routed)."""
+        return AxisComms(self.axes[0])
+
+    def device_comms(self) -> AxisComms:
+        """Collectives over the flattened mesh (both axes): psum-family
+        ops accept the axis tuple directly."""
+        return AxisComms(self.axes)
+
+    def hierarchical_allreduce(self, x):
+        """Bandwidth-optimal multi-level allreduce, stated explicitly:
+        reduce-scatter within the slice (ICI), allreduce the shards across
+        slices (DCN moves only 1/inner_size of the bytes), allgather the
+        result back within the slice — the structure NCCL's tree/hierarchy
+        algorithms use across nodes. Call inside shard_map over the 2D
+        mesh; requires x.shape[0] divisible by the inner size.
+        """
+        inner, outer = self.inner_comms(), self.outer_comms()
+        inner_size = self.mesh.shape[self.axes[1]]
+        errors.expects(
+            x.shape[0] % inner_size == 0,
+            "hierarchical_allreduce: leading dim %d not divisible by the "
+            "inner (slice) size %d", x.shape[0], inner_size,
+        )
+        shard = inner.reducescatter(x, tiled=True)
+        shard = outer.allreduce(shard)
+        return inner.allgather(shard, tiled=True)
+
+
 def build_comms(devices=None, axis: str = "ranks") -> Comms:
     """Analog of ``build_comms_nccl_only`` (helper.hpp:37-45)."""
     return Comms(devices=devices, axis=axis)
+
+
+def build_comms_hierarchical(
+    devices=None, mesh_shape=None, axes=("dcn", "ici")
+) -> HierarchicalComms:
+    """Two-level (multi-host style) communicator; see
+    :class:`HierarchicalComms`."""
+    return HierarchicalComms(devices=devices, mesh_shape=mesh_shape, axes=axes)
 
 
 def inject_comms(resources, comms: Comms) -> None:

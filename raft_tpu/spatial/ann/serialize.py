@@ -15,7 +15,6 @@ hosts; no pickle.
 from __future__ import annotations
 
 import dataclasses
-import io
 import json
 from typing import Any
 
@@ -41,6 +40,8 @@ _TYPES = {
     "sparse_colblock": SparseColBlockIndex,
 }
 _NAMES = {v: k for k, v in _TYPES.items()}
+# nested dataclasses that may appear inside an index payload
+_NESTED = {"ListStorage": ListStorage}
 
 
 def _flatten(obj: Any, prefix: str, arrays: dict, static: dict) -> None:
@@ -50,6 +51,12 @@ def _flatten(obj: Any, prefix: str, arrays: dict, static: dict) -> None:
         if v is None:
             static[key] = None
         elif dataclasses.is_dataclass(v):
+            errors.expects(
+                type(v).__name__ in _NESTED,
+                "save_index: nested dataclass %s is not registered in "
+                "serialize._NESTED (it could not be rebuilt at load time)",
+                type(v).__name__,
+            )
             static[key] = {"__nested__": type(v).__name__}
             _flatten(v, key + ".", arrays, static)
         elif isinstance(v, (jax.Array, np.ndarray)):
@@ -81,16 +88,17 @@ def save_index(index, path) -> None:
         "version": _VERSION,
         "static": static,
     }
-    buf = io.BytesIO()
-    np.savez(
-        buf,
-        __header__=np.frombuffer(
-            json.dumps(header).encode("utf-8"), dtype=np.uint8
-        ),
-        **arrays,
-    )
+    # write straight to the file object: np.savez accepts one (and then
+    # does not append ".npz" to the name), and the archive is not
+    # duplicated in RAM — index payloads run to hundreds of MB
     with open(path, "wb") as f:
-        f.write(buf.getvalue())
+        np.savez(
+            f,
+            __header__=np.frombuffer(
+                json.dumps(header).encode("utf-8"), dtype=np.uint8
+            ),
+            **arrays,
+        )
 
 
 def _rebuild(cls, prefix: str, npz, static: dict):
@@ -108,9 +116,11 @@ def _rebuild(cls, prefix: str, npz, static: dict):
         else:
             v = static.get(key)
             if isinstance(v, dict) and "__nested__" in v:
-                nested_cls = {
-                    "ListStorage": ListStorage,
-                }[v["__nested__"]]
+                errors.expects(
+                    v["__nested__"] in _NESTED,
+                    "load_index: unknown nested type %r", v["__nested__"],
+                )
+                nested_cls = _NESTED[v["__nested__"]]
                 kwargs[f.name] = _rebuild(nested_cls, key + ".", npz, static)
             elif isinstance(v, list):
                 kwargs[f.name] = tuple(v)

@@ -138,13 +138,11 @@ def mean_center(x, mu=None, *, axis: int = 0):
     x = jnp.asarray(x)
     if mu is None:
         mu = mean(x, axis=axis)
-    mu = jnp.asarray(mu)
-    return x - (mu[None, :] if axis == 0 else mu[:, None])
+    return x - jnp.expand_dims(jnp.asarray(mu), axis)
 
 
 def mean_add(x, mu, *, axis: int = 0):
     """Add per-axis means back (reference stats/mean_center.cuh:69
     ``meanAdd`` — the inverse of :func:`mean_center`)."""
     x = jnp.asarray(x)
-    mu = jnp.asarray(mu)
-    return x + (mu[None, :] if axis == 0 else mu[:, None])
+    return x + jnp.expand_dims(jnp.asarray(mu), axis)

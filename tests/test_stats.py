@@ -223,3 +223,12 @@ def test_mean_center_and_add(rng_np):
     # row centering (bcastAlongRows=False analog)
     cr = np.asarray(mean_center(x, axis=1))
     np.testing.assert_allclose(cr.mean(axis=1), 0.0, atol=1e-5)
+
+
+def test_mean_center_3d(rng_np):
+    from raft_tpu.stats import mean_center
+
+    x = rng_np.standard_normal((2, 3, 4)).astype(np.float32)
+    for axis in (0, 1, 2):
+        c = np.asarray(mean_center(x, axis=axis))
+        np.testing.assert_allclose(c.mean(axis=axis), 0.0, atol=1e-5)
