@@ -96,7 +96,7 @@ def extra_big_knn():
     def search(qq):
         return brute_force_knn(
             parts, qq, k, metric=DistanceType.L2Expanded,
-            use_fused=True, compute_dtype=jnp.bfloat16, extra_chunks=32,
+            use_fused=True, compute_dtype=jnp.bfloat16, extra_chunks=16,
         )
 
     def timed(n_disp, seed):
@@ -120,15 +120,21 @@ def extra_big_knn():
 
     float(jnp.sum(search(jax.random.normal(key, (nq, d), jnp.float32))[0]))
     n1, n2 = 2, 8
-    t1 = timed(n1, 1000)
-    t2 = timed(n2, 2000)
-    ms = (t2 - t1) / (n2 - n1) * 1e3
+    # median of 3 difference quotients: single quotients through the axon
+    # tunnel measured a 2.5x run-to-run spread
+    quotients = []
+    for rep in range(3):
+        t1 = timed(n1, 1000 + 20 * rep)
+        t2 = timed(n2, 2000 + 20 * rep)
+        quotients.append((t2 - t1) / (n2 - n1) * 1e3)
+    ms = sorted(quotients)[1]
     return {
         "metric": f"knn_fused_bf16_{n}x{d}_q{nq}_k{k}",
         "value": round(nq / (ms / 1e3), 1),
         "unit": "QPS",
         "index_gb": round(n * d * 2 / 1e9, 1),
         "partitions": n_parts,
+        "extra_chunks": 16,
     }
 
 

@@ -25,6 +25,9 @@ from raft_tpu.spatial.ann.ivf_sq import (
     ivf_sq_build,
     ivf_sq_search,
 )
+from raft_tpu.spatial.ann.approx import (
+    approx_knn_build_index, approx_knn_search,
+)
 from raft_tpu.spatial.ann.serialize import save_index, load_index
 from raft_tpu.spatial.ann.ball_cover import (
     BallCoverIndex,
@@ -42,4 +45,5 @@ __all__ = [
     "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
     "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",
     "save_index", "load_index",
+    "approx_knn_build_index", "approx_knn_search",
 ]

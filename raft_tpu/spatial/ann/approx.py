@@ -1,0 +1,103 @@
+"""Generic ANN entry points — the analog of the reference's
+``approx_knn_build_index`` / ``approx_knn_search``
+(cpp/include/raft/spatial/knn/detail/ann_quantized_faiss.cuh:115-206,
+public spatial/knn/ann.cuh), which dispatch on the dynamic type of the
+``knnIndexParam`` subclass (ann_common.h: IVFFlatParam / IVFPQParam /
+IVFSQParam). Here the dispatch key is the params dataclass type at build
+and the index pytree type at search.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Tuple
+
+import jax
+
+from raft_tpu import errors
+from raft_tpu.spatial.ann.ivf_flat import (
+    IVFFlatIndex, IVFFlatParams, ivf_flat_build, ivf_flat_search,
+    ivf_flat_search_grouped,
+)
+from raft_tpu.spatial.ann.ivf_pq import (
+    IVFPQIndex, IVFPQParams, ivf_pq_build, ivf_pq_search,
+    ivf_pq_search_grouped,
+)
+from raft_tpu.spatial.ann.ivf_sq import (
+    IVFSQIndex, IVFSQParams, ivf_sq_build, ivf_sq_search,
+)
+
+__all__ = ["approx_knn_build_index", "approx_knn_search"]
+
+_BUILDERS = {
+    IVFFlatParams: ivf_flat_build,
+    IVFPQParams: ivf_pq_build,
+    IVFSQParams: ivf_sq_build,
+}
+
+# (per-query latency path, grouped throughput path or None)
+_SEARCHERS = {
+    IVFFlatIndex: (ivf_flat_search, ivf_flat_search_grouped),
+    IVFPQIndex: (ivf_pq_search, ivf_pq_search_grouped),
+    IVFSQIndex: (ivf_sq_search, None),
+}
+
+
+def approx_knn_build_index(x, params):
+    """Build the ANN index selected by the dynamic params type
+    (reference approx_knn_build_index:115 — `dynamic_cast<IVFFlatParam*>`
+    etc.)."""
+    builder = _BUILDERS.get(type(params))
+    errors.expects(
+        builder is not None,
+        "approx_knn_build_index: unknown params type %s (expected one of %s)",
+        type(params).__name__, sorted(c.__name__ for c in _BUILDERS),
+    )
+    return builder(x, params)
+
+
+def approx_knn_search(
+    index, queries, k: int, *, n_probes: int = 8, mode: str = "auto",
+    **kw,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search any ANN index (reference approx_knn_search:169).
+
+    ``mode``: "latency" (per-query path), "throughput" (grouped list-major
+    path where the index family has one), or "auto" — throughput when the
+    batch is large (>= 1024 queries), matching the measured regime split
+    in bench/bench_ann.py.
+    """
+    entry = _SEARCHERS.get(type(index))
+    errors.expects(
+        entry is not None,
+        "approx_knn_search: unknown index type %s (expected one of %s)",
+        type(index).__name__, sorted(c.__name__ for c in _SEARCHERS),
+    )
+    errors.expects(
+        mode in ("auto", "latency", "throughput"),
+        "approx_knn_search: unknown mode %r", mode,
+    )
+    per_query, grouped = entry
+    nq = queries.shape[0]
+
+    def call(fn):
+        # forward only the kwargs the chosen path accepts — auto dispatch
+        # must not turn a valid call into a TypeError because the OTHER
+        # path's tuning knob was supplied (block_q vs qcap/list_block)
+        params = inspect.signature(
+            inspect.unwrap(getattr(fn, "__wrapped__", fn))
+        ).parameters
+        return fn(
+            index, queries, k, n_probes=n_probes,
+            **{n: v for n, v in kw.items() if n in params},
+        )
+
+    if mode == "throughput" or (mode == "auto" and nq >= 1024):
+        errors.expects(
+            grouped is not None or mode == "auto",
+            "approx_knn_search: %s has no throughput (grouped) path",
+            type(index).__name__,
+        )
+        if grouped is not None:
+            return call(grouped)
+    return call(per_query)

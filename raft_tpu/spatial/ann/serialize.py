@@ -52,7 +52,7 @@ def _flatten(obj: Any, prefix: str, arrays: dict, static: dict) -> None:
             static[key] = None
         elif dataclasses.is_dataclass(v):
             errors.expects(
-                type(v).__name__ in _NESTED,
+                _NESTED.get(type(v).__name__) is type(v),
                 "save_index: nested dataclass %s is not registered in "
                 "serialize._NESTED (it could not be rebuilt at load time)",
                 type(v).__name__,

@@ -249,3 +249,27 @@ def test_sparse_colblock_index_serialization(tmp_path, rng_np):
     d1, i1 = sparse_brute_force_knn(loaded, qry, 5, metric="sqeuclidean")
     np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
     np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)
+
+
+def test_approx_knn_generic_dispatch(dataset):
+    """Generic build/search entry (reference approx_knn_build_index /
+    approx_knn_search dynamic dispatch on the param type)."""
+    from raft_tpu.spatial.ann import (
+        approx_knn_build_index, approx_knn_search,
+    )
+    from raft_tpu import errors as err
+    import pytest
+
+    x, q = dataset
+    bd, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    for params in (
+        IVFFlatParams(n_lists=16, kmeans_n_iters=6),
+        IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=6),
+        IVFSQParams(n_lists=16, kmeans_n_iters=6),
+    ):
+        idx = approx_knn_build_index(x, params)
+        d, i = approx_knn_search(idx, q, 10, n_probes=8)
+        r = recall(np.asarray(i), np.asarray(bi))
+        assert r > 0.8, (type(params).__name__, r)
+    with pytest.raises(err.RaftException):
+        approx_knn_build_index(x, object())
