@@ -99,35 +99,22 @@ def extra_big_knn():
             use_fused=True, compute_dtype=jnp.bfloat16, extra_chunks=16,
         )
 
-    def timed(n_disp, seed):
-        qs = [
-            jax.random.normal(jax.random.fold_in(key, seed + i), (nq, d),
-                              jnp.float32)
-            for i in range(n_disp)
-        ]
-        float(sum(jnp.sum(qq) for qq in qs))  # materialize inputs first
-        t0 = time.perf_counter()
-        # chain each search on the previous result: device-serialized, so
-        # only ONE search's transients are live (8 concurrent in-flight
-        # searches next to the 14 GB index would exhaust HBM), and still
-        # a single terminal sync
-        prev = jnp.float32(0.0)
-        for i in range(n_disp):
-            v, _ = search(qs[i] + prev * 0)
-            prev = jnp.sum(v)
-        float(prev)
-        return time.perf_counter() - t0
+    from bench.common import chained_dispatch_ms
 
     float(jnp.sum(search(jax.random.normal(key, (nq, d), jnp.float32))[0]))
-    n1, n2 = 2, 8
-    # median of 3 difference quotients: single quotients through the axon
-    # tunnel measured a 2.5x run-to-run spread
-    quotients = []
-    for rep in range(3):
-        t1 = timed(n1, 1000 + 20 * rep)
-        t2 = timed(n2, 2000 + 20 * rep)
-        quotients.append((t2 - t1) / (n2 - n1) * 1e3)
-    ms = sorted(quotients)[1]
+    # chained dispatches: device-serialized by the data dependence, so
+    # only ONE search's transients are live next to the 14 GB index;
+    # median of 3 quotients (single quotients through the axon tunnel
+    # measured a 2.5x run-to-run spread)
+    ms = chained_dispatch_ms(
+        lambda salt: jax.random.normal(
+            jax.random.fold_in(key, salt), (nq, d), jnp.float32
+        ),
+        search,
+    )
+    if ms is None:
+        return {"metric": f"knn_fused_bf16_{n}x{d}_q{nq}_k{k}",
+                "error": "quotient jitter-dominated"}
     return {
         "metric": f"knn_fused_bf16_{n}x{d}_q{nq}_k{k}",
         "value": round(nq / (ms / 1e3), 1),

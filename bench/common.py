@@ -80,3 +80,42 @@ def bench_fn(make_fn: Callable, *args, iters: int = 40, name: str = "",
         rec["unit"] = unit
     print(json.dumps(rec))
     return ms
+
+
+def chained_dispatch_ms(make_input, run, n1: int = 2, n2: int = 8,
+                        reps: int = 3):
+    """Two-point timing for programs too large for the loop-in-jit harness
+    (Pallas grid-step limits, multi-hundred-MB working sets): dispatch a
+    chain of ``run(input_i + prev * 0)`` calls — device-serialized by the
+    data dependence so only one call's transients are live — and take the
+    median of ``reps`` difference quotients (T(n2) - T(n1)) / (n2 - n1).
+
+    ``make_input(salt)`` must return a fresh input per salt (identical
+    inputs would hit the axon result memoization). The chain dependence is
+    sanitized to finite values so an inf-padded result cannot poison later
+    inputs with NaN. Inputs are materialized before the clock starts.
+
+    Returns ms per dispatch, or None when the quotient is non-positive
+    (jitter-dominated: the workload is too fast to resolve this way).
+    """
+    def reduce_finite(out):
+        leaf = jax.tree.leaves(out)[0]
+        return jnp.sum(jnp.where(jnp.isfinite(leaf), leaf, 0.0))
+
+    def timed(n, salt0):
+        xs = [make_input(salt0 + i) for i in range(n)]
+        float(sum(jnp.sum(x) for x in xs))  # materialize before the clock
+        t0 = time.perf_counter()
+        prev = jnp.float32(0.0)
+        for x in xs:
+            prev = reduce_finite(run(x + prev * 0))
+        float(prev)
+        return time.perf_counter() - t0
+
+    quotients = []
+    for rep in range(reps):
+        t1 = timed(n1, 10_000 * (rep + 1))
+        t2 = timed(n2, 20_000 * (rep + 1))
+        quotients.append((t2 - t1) / (n2 - n1) * 1e3)
+    ms = sorted(quotients)[len(quotients) // 2]
+    return ms if ms > 0 else None
