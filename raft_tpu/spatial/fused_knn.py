@@ -47,6 +47,9 @@ __all__ = ["fused_l2_knn", "fused_knn_supported", "fused_grid_ok"]
 
 _CHUNK = 128  # lane width: one chunk-min per vreg row per reduce
 
+# Per-program grid-step budget for one Pallas call — see _max_grid_steps()
+_MAX_GRID_STEPS_DEFAULT = 6000
+
 
 def _cdiv(a, b):
     return -(-a // b)
@@ -205,7 +208,8 @@ def _rescore_scores(q, cids, yp, *, c, interpret):
 @functools.partial(
     jax.jit,
     static_argnames=("k", "metric", "bm", "bn", "bq2", "extra_chunks",
-                     "compute_dtype", "interpret", "gather_rows"),
+                     "compute_dtype", "interpret", "gather_rows",
+                     "grid_limit"),
 )
 def _fused_l2_knn_impl(
     queries,
@@ -220,6 +224,8 @@ def _fused_l2_knn_impl(
     compute_dtype,
     interpret: bool,
     gather_rows=None,
+    index_norms=None,
+    grid_limit: int = _MAX_GRID_STEPS_DEFAULT,
 ) -> Tuple[jax.Array, jax.Array]:
     m, d = queries.shape
     n = index.shape[0]
@@ -238,7 +244,14 @@ def _fused_l2_knn_impl(
     # multi-GB index is not reliably elided and would copy it (fatal for
     # the HBM-resident big-index regime)
     yp = y if npad == n else jnp.pad(y, ((0, npad - n), (0, 0)))
-    yn = jnp.einsum("nd,nd->n", y, y, preferred_element_type=jnp.float32)
+    # caller-precomputed norms skip a full index read per search — the
+    # analog of the reference storing norms with the index
+    # (knn_brute_force_faiss.cuh:318-330 norms argument)
+    yn = (
+        jnp.asarray(index_norms, jnp.float32)
+        if index_norms is not None
+        else jnp.einsum("nd,nd->n", y, y, preferred_element_type=jnp.float32)
+    )
     ynp = yn if npad == n else jnp.pad(yn, (0, npad - n), constant_values=BIG)
 
     cmins = _chunk_mins(
@@ -267,7 +280,7 @@ def _fused_l2_knn_impl(
     use_dma = (
         gather_rows is None
         and cpad <= nC
-        and mp8 <= _MAX_GRID_STEPS
+        and mp8 <= grid_limit
         # Mosaic slab slices must be lane-aligned: narrower / ragged
         # feature dims take the XLA gather fallback (small-d regime,
         # where the chunk-major gather is cheap anyway)
@@ -367,7 +380,54 @@ _L2_FAMILY = (
     DistanceType.L2Unexpanded,
 )
 
-_MAX_GRID_STEPS = 6000
+# The default grid budget was measured against THIS environment's compile
+# helper (6144 compiles, 7812 does not); because such limits can move
+# across toolchain updates it is overridable via RAFT_TPU_MAX_GRID_STEPS
+# (read at call time — set it before the first call for a given shape, as
+# compiled programs cache their routing), and `probe_grid_steps(n)` lets a
+# deployment verify a candidate budget once (trivial-kernel AOT compile)
+# before raising it.
+def _max_grid_steps() -> int:
+    import os
+
+    env = os.environ.get("RAFT_TPU_MAX_GRID_STEPS")
+    if not env:
+        return _MAX_GRID_STEPS_DEFAULT
+    try:
+        val = int(env)
+    except ValueError:
+        raise ValueError(
+            f"RAFT_TPU_MAX_GRID_STEPS must be a positive integer, "
+            f"got {env!r}"
+        ) from None
+    if val <= 0:
+        raise ValueError(
+            f"RAFT_TPU_MAX_GRID_STEPS must be positive, got {val}"
+        )
+    return val
+
+
+def probe_grid_steps(steps: int) -> bool:
+    """Whether a trivial ``steps``-step Pallas grid compiles on the current
+    backend — a one-time probe deployments can run before overriding
+    RAFT_TPU_MAX_GRID_STEPS (the compile-helper grid budget is an
+    environment property, not an architectural constant)."""
+
+    def _k(x_ref, o_ref):
+        o_ref[:, :] = x_ref[:, :]
+
+    try:
+        fn = pl.pallas_call(
+            _k,
+            grid=(steps,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        )
+        jax.jit(fn).lower(jnp.zeros((8, 128), jnp.float32)).compile()
+        return True
+    except Exception:
+        return False
 
 
 def _plan_blocks(m: int, n: int, d: int, bm: int = 1024, bn: int = 2048):
@@ -393,7 +453,7 @@ def fused_grid_ok(m: int, n: int, d: int, bm: int = 1024,
     helper's per-program grid-step limit (callers above the limit should
     partition the index or take the scan path)."""
     pbm, pbn = _plan_blocks(m, n, d, bm, bn)
-    return _grid_steps(m, n, pbm, pbn) <= _MAX_GRID_STEPS
+    return _grid_steps(m, n, pbm, pbn) <= _max_grid_steps()
 
 
 def fused_knn_supported(
@@ -426,6 +486,7 @@ def fused_l2_knn(
     interpret: Optional[bool] = None,
     gather_rows: Optional[bool] = None,
     init: Optional[Tuple[jax.Array, jax.Array]] = None,
+    index_norms: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact fused kNN for the L2 metric family. Returns (dists (m, k),
     indices (m, k)) best-first, matching ``brute_force_knn``.
@@ -440,6 +501,12 @@ def fused_l2_knn(
     merged best-of-both, so a multi-partition search can thread results
     partition to partition; the caller owns id translation (as in the
     reference, knn_brute_force_faiss.cuh:240-254).
+
+    ``index_norms``: optional precomputed ``sum(index**2, axis=1)`` (f32,
+    shape (n,)). Searching many query batches against a fixed index
+    otherwise re-reads the whole index once per call for norms — the
+    reference stores norms with the index for the same reason
+    (knn_brute_force_faiss.cuh:318-330).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -458,18 +525,27 @@ def fused_l2_knn(
     # kernel per partition and knn_merge_parts the results (its auto
     # dispatch checks fused_grid_ok and falls back to the scan path).
     steps = _grid_steps(m, n, bm, bn)
-    if steps > _MAX_GRID_STEPS:
+    limit = _max_grid_steps()
+    if steps > limit:
         raise ValueError(
-            f"fused kNN grid too large ({steps} steps > {_MAX_GRID_STEPS}): "
+            f"fused kNN grid too large ({steps} steps > {limit}): "
             f"split the index into partitions of <= "
-            f"{_MAX_GRID_STEPS // _cdiv(m, bm) * bn} rows "
+            f"{limit // _cdiv(m, bm) * bn} rows "
             f"and use brute_force_knn(partitions, ...)"
         )
+    if index_norms is not None:
+        index_norms = jnp.asarray(index_norms)
+        errors_ok = index_norms.ndim == 1 and index_norms.shape[0] == n
+        if not errors_ok:
+            raise ValueError(
+                f"index_norms must have shape ({n},), got {index_norms.shape}"
+            )
     vals, idxs = _fused_l2_knn_impl(
         queries, index, k, metric,
         bm=bm, bn=bn, bq2=bq2, extra_chunks=extra_chunks,
         compute_dtype=jnp.dtype(compute_dtype),
         interpret=interpret, gather_rows=gather_rows,
+        index_norms=index_norms, grid_limit=limit,
     )
     if init is not None:
         from raft_tpu.spatial.selection import merge_topk

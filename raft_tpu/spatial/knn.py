@@ -158,6 +158,7 @@ def brute_force_knn(
     use_fused: Optional[bool] = None,
     compute_dtype=None,
     extra_chunks: Optional[int] = None,
+    index_norms: Optional[Sequence] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Brute-force kNN over one or more index partitions.
 
@@ -175,6 +176,11 @@ def brute_force_knn(
     is the HBM-resident big-index mode — partitioning also keeps each
     Pallas grid under the compiler's step limit, so a ~14 GB index runs
     as 3-4 bf16 partitions (the 10M x 768 BASELINE regime).
+
+    ``index_norms``: optional per-partition precomputed squared row norms
+    (list matching ``index``); repeated searches against a fixed index
+    then skip one full index read per call (fused path only — the
+    reference's stored-norms argument, knn_brute_force_faiss.cuh:318-330).
 
     Returns (distances (m, k), indices (m, k)), best-first.
     """
@@ -229,25 +235,40 @@ def brute_force_knn(
     # rest). Checked BEFORE any search runs — not after paying for the
     # full dispatch.
     errors.expects(
-        (compute_dtype is None and extra_chunks is None) or any(routes),
-        "compute_dtype/extra_chunks tune the fused path, but every "
-        "partition routed to the scan path; pass use_fused=True to force "
-        "fused, or drop the tuning args",
+        (compute_dtype is None and extra_chunks is None
+         and index_norms is None) or any(routes),
+        "compute_dtype/extra_chunks/index_norms tune the fused path, but "
+        "every partition routed to the scan path; pass use_fused=True to "
+        "force fused, or drop the tuning args",
     )
 
-    def _search_part(pt, fused):
+    errors.expects(
+        index_norms is None or len(index_norms) == len(parts),
+        "index_norms: %d norm vectors for %d partitions",
+        0 if index_norms is None else len(index_norms), len(parts),
+    )
+
+    def _search_part(pt, fused, norms):
         if fused:
             kw = {}
             if compute_dtype is not None:
                 kw["compute_dtype"] = compute_dtype
             if extra_chunks is not None:
                 kw["extra_chunks"] = extra_chunks
-            return fused_l2_knn(queries, pt, k, metric=metric, **kw)
+            return fused_l2_knn(
+                queries, pt, k, metric=metric, index_norms=norms, **kw
+            )
         return _knn_single_part(
             queries, pt, k, metric, p, block_n, block_q, exact
         )
 
-    results = [_search_part(pt, f) for pt, f in zip(parts, routes)]
+    norms_list = (
+        list(index_norms) if index_norms is not None else [None] * len(parts)
+    )
+    results = [
+        _search_part(pt, f, nr)
+        for pt, f, nr in zip(parts, routes, norms_list)
+    ]
     if len(parts) == 1:
         d0, i0 = results[0]
         return d0, i0 + jnp.int32(offs[0])

@@ -120,6 +120,28 @@ def test_fused_knn_aligned_index_no_pad(rng_np):
     np.testing.assert_allclose(np.asarray(d1), want_d, rtol=1e-4, atol=1e-4)
 
 
+def test_fused_knn_index_norms_matches(rng_np):
+    """Caller-precomputed index norms (the stored-norms search mode,
+    reference knn_brute_force_faiss.cuh:318-330) must be bit-identical to
+    the self-computed path, and wrong shapes must raise."""
+    q = rng_np.standard_normal((19, 32)).astype(np.float32)
+    y = rng_np.standard_normal((12000, 32)).astype(np.float32)
+    norms = (y.astype(np.float32) ** 2).sum(1)
+    d1, i1 = fused_l2_knn(q, y, 5)
+    d2, i2 = fused_l2_knn(q, y, 5, index_norms=norms)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1e-6)
+    with pytest.raises(ValueError):
+        fused_l2_knn(q, y, 5, index_norms=norms[:-1])
+    # threaded through the partitioned entry point
+    d3, i3 = brute_force_knn(
+        [y[:6000], y[6000:]], q, 5, use_fused=True,
+        index_norms=[norms[:6000], norms[6000:]],
+    )
+    d4, i4 = brute_force_knn([y[:6000], y[6000:]], q, 5, use_fused=True)
+    np.testing.assert_array_equal(np.asarray(i3), np.asarray(i4))
+
+
 def test_fused_knn_warm_start(rng_np):
     """Warm-starting partition B's search with partition A's (translated)
     results equals one search over A + B (the reference's previous-top-k
