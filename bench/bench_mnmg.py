@@ -1,0 +1,114 @@
+"""MNMG weak-scaling benchmarks on the virtual 8-device CPU mesh —
+the BASELINE.md config-5 shape ("MNMG brute-force kNN via comms
+allreduce over a pod", reference std_comms.hpp:55 +
+knn_brute_force_faiss.cuh:365) made measurable without pod hardware.
+
+Methodology: rows-per-device held CONSTANT while the device count grows
+1 -> 2 -> 4 -> 8 (weak scaling): perfect scaling = flat time per step.
+Caveats on the virtual mesh: all "devices" share one host's cores, so
+the curve conflates collective overhead with compute CONTENTION and
+upper-bounds both; absolute numbers are XLA:CPU numbers. The
+topology-portable artifact is the per-step collective-byte accounting
+(payload shapes are identical on a pod, where the same program rides
+ICI) plus the program structure itself, which the multichip dryrun
+compiles and executes.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+          python -m bench.bench_mnmg
+"""
+
+import json
+import os
+import sys
+import time
+
+if __name__ == "__main__" and "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _bytes_gb(b):
+    return round(b / 1e9, 4)
+
+
+def bench_weak_scaling():
+    from raft_tpu.cluster.kmeans import KMeansParams
+    from raft_tpu.comms.comms import Comms
+    from raft_tpu.comms.mnmg import mnmg_kmeans_fit, mnmg_knn
+    from raft_tpu.comms.ring import ring_knn
+
+    devs = jax.devices()
+    rows_per_dev, d, k_clusters, nq, topk = 16_384, 64, 64, 512, 10
+    rng = np.random.default_rng(0)
+
+    for P in (1, 2, 4, 8):
+        if P > len(devs):
+            break
+        comms = Comms(devices=devs[:P])
+        n = rows_per_dev * P
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        q = rng.standard_normal((nq, d)).astype(np.float32)
+
+        # ---- kmeans: time/iter via two-program difference ------------
+        def fit(iters):
+            t0 = time.perf_counter()
+            out = mnmg_kmeans_fit(
+                comms, x,
+                KMeansParams(n_clusters=k_clusters, max_iter=iters,
+                             tol=0.0, seed=0, init="random"),
+            )
+            jax.block_until_ready(out.centroids)
+            return time.perf_counter() - t0, int(out.n_iter)
+
+        fit(2), fit(8)                       # compile both programs
+        t2, i2 = fit(2)
+        t8, i8 = fit(8)
+        s_per_iter = max(t8 - t2, 1e-9) / max(i8 - i2, 1)
+        # collective bytes per iteration: psum(sums (k,d) f32) +
+        # psum(counts (k,)) + psum(residual) + reseed allgathers
+        # ((P*k) + (P*k, d) f32). ring-allreduce wire bytes/device =
+        # 2 * (P-1)/P * payload.
+        payload = (k_clusters * d + k_clusters + 1) * 4 \
+            + P * k_clusters * (d + 1) * 4
+        wire = 2 * (P - 1) / max(P, 1) * payload
+        print(json.dumps({
+            "name": f"mnmg/kmeans_weak/P{P}",
+            "rows_total": n,
+            "s_per_iter": round(s_per_iter, 4),
+            "iters_per_s": round(1.0 / s_per_iter, 2),
+            "collective_gb_per_iter_per_dev": _bytes_gb(wire),
+        }))
+
+        # ---- kNN: index sharded, queries replicated ------------------
+        def run_knn(fn, name):
+            fn(comms, x, q, topk)            # compile
+            t0 = time.perf_counter()
+            dv, iv = fn(comms, x, q, topk)
+            jax.block_until_ready(dv)
+            dt = time.perf_counter() - t0
+            print(json.dumps({
+                "name": f"mnmg/{name}_weak/P{P}",
+                "rows_total": n,
+                "ms": round(dt * 1e3, 1),
+                "qps": round(nq / dt, 1),
+            }))
+
+        run_knn(mnmg_knn, "knn_allgather")
+        run_knn(ring_knn, "knn_ring")
+
+
+def main():
+    bench_weak_scaling()
+
+
+if __name__ == "__main__":
+    main()

@@ -164,19 +164,20 @@ def mnmg_kmeans_fit(
 
         def assign(cents):
             minv, mini = fused_l2_nn(x_loc, cents)
-            minv = jnp.where(valid, minv, 0.0)
-            return mini, ax.allreduce(jnp.sum(minv))
+            return mini, minv
 
-        def reseed_empty(cents, counts):
+        def reseed_empty(cents, counts, minv):
             # global reseed matching the single-device path (reference
             # detail/kmeans.cuh:882-896): empty centroids jump onto the
             # globally farthest points. Each rank contributes its local
             # top-k farthest rows; an allgather builds the global pool and
-            # every rank picks the same winners (deterministic).
-            minv, _ = fused_l2_nn(x_loc, cents)
-            minv = jnp.where(valid, minv, -jnp.inf)
+            # every rank picks the same winners (deterministic). ``minv``
+            # is REUSED from this iteration's assignment — recomputing it
+            # would cost another full (m, k, d) pass (the structure the
+            # single-device _lloyd documents).
+            mv = jnp.where(valid, minv, -jnp.inf)
             kk = min(k, x_loc.shape[0])
-            lv, li = lax.top_k(minv, kk)
+            lv, li = lax.top_k(mv, kk)
             cand = x_loc[li]                          # (kk, d)
             all_v = ax.allgather(lv, tiled=True)      # (P*kk,)
             all_c = ax.allgather(cand, tiled=True)    # (P*kk, d)
@@ -192,8 +193,13 @@ def mnmg_kmeans_fit(
             )
 
         def step(state):
-            it, cents, _, res, labels = state
-            labels, _ = assign(cents)
+            # ONE fused assignment per iteration (the _lloyd structure,
+            # kmeans.py): it yields the labels, the residual of the
+            # current centroids, AND the farthest-point pool for empty
+            # reseeding — the previous assign/reseed/re-assign structure
+            # paid 3 full (m, k, d) passes per iteration
+            it, cents, _, res, _ = state
+            labels, minv = assign(cents)
             labels_upd = jnp.where(valid, labels, k)  # padded rows -> dropped
             sums, counts = _update_centroids(
                 x_loc, labels_upd, k, params.block_rows
@@ -203,18 +209,24 @@ def mnmg_kmeans_fit(
             new_cents = (sums / jnp.maximum(counts, 1.0)[:, None]).astype(
                 x_loc.dtype
             )
-            new_cents = reseed_empty(new_cents, counts)
-            _, new_res = assign(new_cents)
+            new_cents = reseed_empty(new_cents, counts, minv)
+            new_res = ax.allreduce(
+                jnp.sum(jnp.where(valid, minv, 0.0))
+            )
             return it + 1, new_cents, res, new_res, labels
 
         def cond(state):
             it, _, prev, res, _ = state
             return (it < params.max_iter) & (jnp.abs(prev - res) / n > params.tol)
 
-        labels0, res0 = assign(cents0)
-        state = (jnp.int32(0), cents0, jnp.float32(jnp.inf), res0, labels0)
+        labels0 = jnp.zeros((shard_rows,), jnp.int32)
+        state = (
+            jnp.int32(0), cents0, jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+            labels0,
+        )
         it, cents, _, res, _ = lax.while_loop(cond, step, state)
-        labels, res = assign(cents)
+        labels, minv = assign(cents)
+        res = ax.allreduce(jnp.sum(jnp.where(valid, minv, 0.0)))
         return cents, labels.astype(jnp.int32), res, it
 
     sm = comms.shard_map(

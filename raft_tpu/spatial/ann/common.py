@@ -162,6 +162,69 @@ def default_qcap(nq: int, n_probes: int, n_lists: int) -> int:
     return min(nq, -(-2 * mean_occ // 8) * 8)
 
 
+def probe_drop_stats(probes, n_lists: int, qcap: int):
+    """Dropped (query, probe) pairs for a probe map under a ``qcap``:
+    slots fill in probe-rank order, so exactly ``max(0, occupancy - qcap)``
+    pairs per list overflow. Returns {"dropped", "total", "frac"} — the
+    diagnostic for unexplained grouped-search recall dips (a user with
+    adversarially clustered queries sees the drop fraction here instead
+    of guessing)."""
+    occ = np.bincount(
+        np.asarray(probes).reshape(-1), minlength=n_lists
+    )
+    total = int(occ.sum())
+    dropped = int(np.maximum(occ - qcap, 0).sum())
+    return {
+        "dropped": dropped,
+        "total": total,
+        "frac": dropped / max(total, 1),
+    }
+
+
+def resolve_qcap(probes, n_lists: int, nq: int, n_probes: int,
+                 max_drop_frac: float = 0.02) -> int:
+    """Auto-size ``qcap`` from the ACTUAL probe map: start at the 2x-mean
+    default and double (8-aligned) until the dropped-pair fraction is at
+    most ``max_drop_frac`` (or every query fits). Logs the residual drop
+    fraction through the library logger so truncation is never silent.
+
+    Under a jax trace (a user wrapping the search in jax.jit) the probe
+    values are unavailable; falls back to the static 2x-mean default —
+    the pre-auto behavior — rather than failing at trace time."""
+    from raft_tpu.core import logger
+
+    if isinstance(probes, jax.core.Tracer):
+        return default_qcap(nq, n_probes, n_lists)
+
+    qcap = default_qcap(nq, n_probes, n_lists)
+    while True:
+        stats = probe_drop_stats(probes, n_lists, qcap)
+        if stats["frac"] <= max_drop_frac or qcap >= nq:
+            break
+        qcap = min(nq, -(-2 * qcap // 8) * 8)
+    if stats["dropped"]:
+        logger.warn(
+            "grouped search qcap=%d drops %d/%d probe pairs (%.3f%%); "
+            "clustered queries overflow hot lists — raise qcap or "
+            "max_drop_frac to trade memory for recall",
+            qcap, stats["dropped"], stats["total"], 100.0 * stats["frac"],
+        )
+    return qcap
+
+
+def auto_qcap(q, centroids, n_lists: int, n_probes: int):
+    """Shared qcap=None path of the grouped searches: eagerly probe, size
+    qcap from the actual map (:func:`resolve_qcap`), and hand the probes
+    back for reuse — or None under an outer jit, where the impl must
+    recompute them. Returns (qcap, probes_or_none)."""
+    nq = q.shape[0]
+    probes, _ = coarse_probe(q.astype(jnp.float32), centroids, n_probes)
+    qcap = resolve_qcap(probes, n_lists, nq, n_probes)
+    if isinstance(probes, jax.core.Tracer):
+        return qcap, None
+    return qcap, probes
+
+
 def check_candidate_pool(k: int, n_probes: int, storage: ListStorage):
     if k > n_probes * storage.max_list:
         raise ValueError(

@@ -57,6 +57,25 @@ class IVFPQParams:
     seed: int = 0
     store_raw: bool = True    # keep raw vectors for exact refinement
     kmeans_init: str = "k-means++"  # "random": cheap coarse/code books
+    # Training-set cap for the coarse quantizer + PQ codebooks: datasets
+    # beyond this size train on a uniform subsample and encode in
+    # streaming blocks (the 10M+ regime; quantizer quality saturates far
+    # below that — FAISS trains its 100M indexes the same way). None =
+    # max(2^20, 64 * n_lists).
+    train_size: typing.Optional[int] = None
+    encode_block: int = 1 << 20  # rows per streaming-encode block
+    # Longest allowed inverted list: lists beyond the cap are split into
+    # sublists sharing the parent's centroid (probing spends adjacent
+    # top-k slots on them — centroid distances tie). Padded-list compute
+    # in the grouped searches scales with n_lists * max_list, so one
+    # swollen list (a dense cluster swallowed whole) would otherwise tax
+    # every list block. Tradeoff: a heavily split cluster consumes
+    # several of a query's n_probes slots (raise n_probes on very skewed
+    # data). None = auto, max(256, 2 * ceil(n / n_lists)) — applied only
+    # on the large-n blocked-build path, where the padding tax is the
+    # scaling blocker; small one-shot builds split only when an explicit
+    # cap is given. 0 = off.
+    max_list_cap: typing.Optional[int] = None
 
 
 @jax.tree_util.register_dataclass
@@ -71,6 +90,92 @@ class IVFPQIndex:
     vectors_sorted: typing.Optional[jax.Array]
     pq_dim: int = dataclasses.field(metadata=dict(static=True))
     pq_bits: int = dataclasses.field(metadata=dict(static=True))
+
+
+def _cdiv_host(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _split_oversized_lists(labels_np, centroids, cap):
+    """Split every list longer than ``cap`` into contiguous sublists that
+    share the parent's centroid (appended as duplicate centroid rows).
+    Host-side, vectorized — build is offline. Returns (labels, centroids);
+    no-op when nothing exceeds the cap."""
+    n_lists = centroids.shape[0]
+    sizes = np.bincount(labels_np, minlength=n_lists)
+    extra = np.maximum(0, -(-sizes // cap) - 1)               # sublists - 1
+    if not extra.any():
+        return labels_np, centroids
+    order = np.argsort(labels_np, kind="stable")
+    lbl_sorted = labels_np[order]
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    rank = np.arange(labels_np.shape[0]) - offsets[lbl_sorted]
+    sub = rank // cap                                         # 0..extra[l]
+    base = n_lists + np.concatenate([[0], np.cumsum(extra)[:-1]])
+    new_sorted = np.where(
+        sub == 0, lbl_sorted, base[lbl_sorted] + sub - 1
+    ).astype(labels_np.dtype)
+    out = np.empty_like(labels_np)
+    out[order] = new_sorted
+    dup = np.repeat(np.arange(n_lists), extra)
+    centroids = jnp.concatenate(
+        [centroids, jnp.take(centroids, jnp.asarray(dup), axis=0)]
+    )
+    return out, centroids
+
+
+def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
+    """Subsample-trained codebooks + streaming full-dataset encode.
+
+    PQ codebooks train on the residuals of the training subsample only;
+    the full dataset is then labeled and coded in ``encode_block``-row
+    blocks by one jitted program (block shape is static, so every block
+    reuses the same executable). Peak transient memory is
+    O(encode_block * d) instead of O(n * d) — the property that lets a
+    16 GB chip build a 10M+ index.
+    """
+    from raft_tpu.cluster.kmeans import kmeans_fit_batched
+
+    n, d = x.shape
+    M = params.pq_dim
+    train_n = xt.shape[0]
+
+    # coarse.labels ARE the training rows' assignments — no second
+    # (train_n, n_lists, d) pass
+    res_t = xt - coarse.centroids[coarse.labels]
+    sub_t = res_t.reshape(train_n, M, ds).transpose(1, 0, 2)  # (M, tn, ds)
+    outs = kmeans_fit_batched(
+        sub_t,
+        KMeansParams(
+            n_clusters=n_codes,
+            max_iter=params.pq_kmeans_n_iters,
+            seed=params.seed + 1,
+            init=params.kmeans_init,
+        ),
+    )
+    codebooks = outs.centroids                                # (M, K, ds)
+
+    @jax.jit
+    def encode_one(blk):
+        lbl = kmeans_predict(blk, coarse.centroids)
+        res = blk - coarse.centroids[lbl]
+        s = res.reshape(blk.shape[0], M, ds).transpose(1, 0, 2)
+        codes = jax.vmap(kmeans_predict)(s, codebooks).T.astype(jnp.uint8)
+        return lbl.astype(jnp.int32), codes
+
+    B = params.encode_block
+    lbl_parts, code_parts = [], []
+    for s0 in range(0, n, B):
+        blk = x[s0:min(s0 + B, n)]
+        if blk.shape[0] < B:
+            blk = jnp.pad(blk, ((0, B - blk.shape[0]), (0, 0)))
+        lbl, codes = encode_one(blk)
+        take = min(B, n - s0)
+        lbl_parts.append(lbl[:take])
+        code_parts.append(codes[:take])
+    labels = jnp.concatenate(lbl_parts)
+    codes = jnp.concatenate(code_parts)
+    return labels, codes, codebooks
 
 
 def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
@@ -88,8 +193,31 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     ds = d // M
     n_codes = 1 << params.pq_bits
 
+    # Large-n build path (the DEEP-100M regime scaled to one chip): train
+    # the coarse quantizer and PQ codebooks on a uniform subsample, then
+    # encode the full dataset in streaming blocks — the same
+    # train-on-subsample / add-in-batches structure FAISS uses under the
+    # reference (ann_quantized_faiss.cuh:115-206 wraps GpuIndexIVFPQ whose
+    # train() subsamples internally). One-shot training never needs more
+    # rows than saturates quantizer quality.
+    train_n = min(
+        n,
+        params.train_size
+        if params.train_size is not None
+        else max(1 << 20, 64 * params.n_lists),
+    )
+    if train_n < n:
+        sel = jnp.sort(
+            jax.random.permutation(jax.random.PRNGKey(params.seed), n)[
+                :train_n
+            ]
+        )
+        xt = jnp.take(x, sel, axis=0)
+    else:
+        xt = x
+
     coarse = kmeans_fit(
-        x,
+        xt,
         KMeansParams(
             n_clusters=params.n_lists,
             max_iter=params.kmeans_n_iters,
@@ -97,6 +225,39 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
             init=params.kmeans_init,
         ),
     )
+
+    blocked = train_n < n or n > params.encode_block
+    if params.max_list_cap is not None:
+        cap = params.max_list_cap
+    else:
+        # auto cap only where it is the scaling blocker (see IVFPQParams)
+        cap = max(256, 2 * _cdiv_host(n, params.n_lists)) if blocked else 0
+
+    if blocked:
+        labels, codes, codebooks = _train_pq_and_encode_blocked(
+            x, xt, coarse, params, ds, n_codes
+        )
+        labels_np, cents_out = np.asarray(labels), coarse.centroids
+        if cap:
+            labels_np, cents_out = _split_oversized_lists(
+                labels_np, cents_out, cap
+            )
+        storage = build_list_storage(labels_np, cents_out.shape[0])
+        codes_sorted = jnp.concatenate(
+            [jnp.take(codes, storage.sorted_ids, axis=0),
+             jnp.zeros((1, M), jnp.uint8)]
+        )
+        vectors_sorted = None
+        if params.store_raw:
+            vectors_sorted = jnp.concatenate(
+                [jnp.take(x, storage.sorted_ids, axis=0),
+                 jnp.zeros((1, d), x.dtype)]
+            )
+        return IVFPQIndex(
+            cents_out, codebooks, codes_sorted, storage,
+            vectors_sorted, M, params.pq_bits,
+        )
+
     labels = coarse.labels
     residuals = x - coarse.centroids[labels]
 
@@ -156,7 +317,12 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
             [encode_sub(sub[m], codebooks[m]) for m in range(M)], axis=1
         ).astype(jnp.uint8)                                 # (n, M)
 
-    storage = build_list_storage(np.asarray(labels), params.n_lists)
+    labels_np, cents_out = np.asarray(labels), coarse.centroids
+    if cap:
+        labels_np, cents_out = _split_oversized_lists(
+            labels_np, cents_out, cap
+        )
+    storage = build_list_storage(labels_np, cents_out.shape[0])
     codes_sorted = jnp.concatenate(
         [codes[storage.sorted_ids], jnp.zeros((1, M), jnp.uint8)]
     )
@@ -166,7 +332,7 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
             [x[storage.sorted_ids], jnp.zeros((1, d), x.dtype)]
         )
     return IVFPQIndex(
-        coarse.centroids, codebooks, codes_sorted, storage, vectors_sorted,
+        cents_out, codebooks, codes_sorted, storage, vectors_sorted,
         M, params.pq_bits,
     )
 
@@ -177,6 +343,7 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
 def ivf_pq_search(
     index: IVFPQIndex, queries, k: int, *, n_probes: int = 8,
     block_q: int = 256, refine_ratio: float = 2.0,
+    refine_dataset=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """ADC search; returns (squared L2 dists, original row ids).
     Query batches run in ``block_q`` blocks so the per-(query, list) LUTs
@@ -185,7 +352,13 @@ def ivf_pq_search(
     ``refine_ratio`` > 1 (and an index built with ``store_raw``) rescores
     the top ``ceil(refine_ratio * k)`` ADC candidates with exact f32
     distances before the final k-selection; returned distances are then
-    exact. ``refine_ratio <= 1`` returns raw ADC approximations."""
+    exact. ``refine_ratio <= 1`` returns raw ADC approximations.
+
+    ``refine_dataset``: the original (n, d) dataset, enabling exact
+    refinement for an index built with ``store_raw=False`` (codes-only
+    memory, M bytes/row) — the caller keeps the dataset, the index stays
+    small; candidates gather through ``storage.sorted_ids``. Ignored when
+    the index stores raw vectors."""
     from raft_tpu.spatial.ann.common import (
         check_candidate_pool, coarse_probe, map_query_blocks,
         score_l2_candidates, select_candidates,
@@ -198,7 +371,9 @@ def ivf_pq_search(
     M = index.pq_dim
     ds = d // M
     check_candidate_pool(k, n_probes, index.storage)
-    refine = index.vectors_sorted is not None and refine_ratio > 1.0
+    refine = (
+        index.vectors_sorted is not None or refine_dataset is not None
+    ) and refine_ratio > 1.0
     c = max(k, min(int(math.ceil(refine_ratio * k)),
                    n_probes * index.storage.max_list))
     f32 = jnp.float32
@@ -238,7 +413,7 @@ def ivf_pq_search(
         # refinement: top-c by ADC score, exact f32 rescore, re-select k
         adc, cpos = jax.lax.top_k(-d2, c)                    # (q, c)
         rpos = jnp.take_along_axis(flat_pos, cpos, axis=1)   # (q, c)
-        raw = index.vectors_sorted[rpos].astype(f32)         # (q, c, d)
+        raw = _gather_refine_rows(index, refine_dataset, rpos, f32)
         exact = score_l2_candidates(
             qf, raw, jnp.isfinite(-adc) & (rpos < index.storage.n)
         )
@@ -247,11 +422,24 @@ def ivf_pq_search(
     return map_query_blocks(one_block, q, block_q)
 
 
+def _gather_refine_rows(index, refine_dataset, rpos, f32):
+    """Candidate raw vectors for exact refinement: from the index's
+    list-sorted copy when stored, else from the caller-held dataset via
+    the sorted-order -> original-id map (codes-only indexes)."""
+    if index.vectors_sorted is not None:
+        return index.vectors_sorted[rpos].astype(f32)
+    oid = index.storage.sorted_ids[
+        jnp.clip(rpos, 0, index.storage.n - 1)
+    ]
+    return jnp.take(refine_dataset, oid, axis=0).astype(f32)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "n_probes", "qcap", "list_block", "refine_ratio"),
 )
-def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio):
+def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
+                     refine_dataset=None, probes=None):
     from raft_tpu.spatial.ann.common import (
         coarse_probe, invert_probe_map, regroup_pairs, score_l2_candidates,
         select_candidates,
@@ -272,7 +460,8 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio):
     cb = jnp.where(jnp.isfinite(index.codebooks), index.codebooks, 0.0)
     cb_n = jnp.sum(cb * cb, axis=2)                          # (M, K)
 
-    probes, _ = coarse_probe(qf, cents, p)                   # (nq, p)
+    if probes is None:
+        probes, _ = coarse_probe(qf, cents, p)               # (nq, p)
     qmat, l_flat, slot = invert_probe_map(probes, n_lists, qcap)
 
     q_pad = jnp.concatenate([qf, jnp.zeros((1, d), f32)])    # sentinel query
@@ -280,7 +469,9 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio):
     # k — on clustered data a query's home list can hold most of the
     # global top-c ADC candidates, and truncating it to k caps recall
     # (measured: 0.73 vs 0.95 at the 500k bench shape with kk = k)
-    refine = index.vectors_sorted is not None and refine_ratio > 1.0
+    refine = (
+        index.vectors_sorted is not None or refine_dataset is not None
+    ) and refine_ratio > 1.0
     kk = min(max(k, int(math.ceil(refine_ratio * k)) if refine else k), L)
 
     def block_fn(lblk):                                      # (LB,) list ids
@@ -298,12 +489,23 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio):
         res_n = jnp.sum(res * res, axis=3)                   # (LB, qcap, M)
         lut = res_n[..., None] + cb_n[None, None] - 2.0 * dots
 
+        # Each list is CONTIGUOUS in sorted storage, so its codes read as
+        # one dynamic_slice slab — row-granular list_index gathers of
+        # M-byte code rows measured ~50x slower at the 10M x 96 shape
+        # (the same contiguity the fused-kNN phase-2 DMA exploits).
+        offs = storage.list_offsets[lblk]                    # (LB,)
+        szs = storage.list_sizes[lblk]
+        o_c = jnp.minimum(offs, storage.n + 1 - L)           # slice clamp
+        codes = jax.vmap(
+            lambda s: lax.dynamic_slice(index.codes_sorted, (s, 0), (L, M))
+        )(o_c)                                               # (LB, L, M) u8
+        pos = o_c[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+        in_list = (pos >= offs[:, None]) & (pos < (offs + szs)[:, None])
+
         # THE grouped-PQ trick: dist[b,q,l] = sum_m lut[b,q,m,codes[b,l,m]]
         # is a matmul between the flattened LUT and the one-hot code
         # matrix — dense MXU work replacing the per-candidate (q,p,L,M)
         # random gather that bounds the per-query path
-        mpos = storage.list_index[lblk]                      # (LB, L)
-        codes = index.codes_sorted[mpos]                     # (LB, L, M) u8
         onehot = (
             codes[..., None] == jnp.arange(K, dtype=jnp.uint8)
         ).astype(bf16)                                       # (LB, L, M, K)
@@ -314,18 +516,36 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio):
             preferred_element_type=f32,
         )                                                    # (LB, qcap, L)
 
-        invalid = (qids >= nq)[:, :, None] | (mpos >= storage.n)[:, None, :]
+        invalid = (qids >= nq)[:, :, None] | (~in_list)[:, None, :]
         d2 = jnp.where(invalid, jnp.inf, d2)
-        vals, sel = lax.top_k(-d2, kk)                       # (LB, qcap, kk)
+        # per-(list, slot) partial selection: when exact refinement runs
+        # downstream, use the TPU hardware approx top-k (lax.approx_min_k,
+        # ~0.95 per-call recall) — this selection only shapes the ADC
+        # candidate pool, and exact lax.top_k here measured ~14x the cost
+        # of everything else in the block at the 10M shape. The UNREFINED
+        # path keeps exact selection: its per-block picks ARE the results.
+        if refine:
+            vals, sel = lax.approx_min_k(d2, kk)             # (LB, qcap, kk)
+        else:
+            nv, sel = lax.top_k(-d2, kk)
+            vals = -nv
         memp = jnp.take_along_axis(
-            jnp.broadcast_to(mpos[:, None, :], d2.shape), sel, axis=2
+            jnp.broadcast_to(pos[:, None, :], d2.shape),
+            sel.astype(jnp.int32), axis=2,
         )
-        return -vals, memp
+        return vals, memp
 
-    lids = jnp.arange(n_lists, dtype=jnp.int32).reshape(-1, list_block)
+    # pad the list axis up to a multiple of list_block (clamped ids — the
+    # padded slots recompute the last list; regroup never references them)
+    # instead of shrinking list_block, which collapses to 1-list blocks
+    # when n_lists is prime-ish (e.g. after oversized-list splitting)
+    nl_pad = -(-n_lists // list_block) * list_block
+    lids = jnp.minimum(
+        jnp.arange(nl_pad, dtype=jnp.int32), n_lists - 1
+    ).reshape(-1, list_block)
     vals, mem = lax.map(block_fn, lids)
-    vals = vals.reshape(n_lists, qcap, kk)
-    mem = mem.reshape(n_lists, qcap, kk)
+    vals = vals.reshape(nl_pad, qcap, kk)[:n_lists]
+    mem = mem.reshape(nl_pad, qcap, kk)[:n_lists]
 
     pv, pm = regroup_pairs(vals, mem, l_flat, slot, nq, p, qcap)
 
@@ -333,10 +553,13 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio):
         return select_candidates(storage, pm, pv, k)
 
     # exact refinement: top-c of the pooled ADC candidates, f32 rescore
+    # (pool selection rides the hardware approx top-k too — same
+    # already-approximate-stage argument as the per-block selection)
     c = max(k, min(int(math.ceil(refine_ratio * k)), p * kk))
-    adc, cpos = lax.top_k(-pv, c)                            # (nq, c)
-    rpos = jnp.take_along_axis(pm, cpos, axis=1)             # (nq, c)
-    raw = index.vectors_sorted[rpos].astype(f32)             # (nq, c, d)
+    nadc, cpos = lax.approx_min_k(pv, c)                     # (nq, c)
+    adc = -nadc
+    rpos = jnp.take_along_axis(pm, cpos.astype(jnp.int32), axis=1)
+    raw = _gather_refine_rows(index, refine_dataset, rpos, f32)
     exact = score_l2_candidates(
         qf, raw, jnp.isfinite(-adc) & (rpos < storage.n)
     )
@@ -346,7 +569,7 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio):
 def ivf_pq_search_grouped(
     index: IVFPQIndex, queries, k: int, *, n_probes: int = 8,
     qcap: typing.Optional[int] = None, list_block: int = 8,
-    refine_ratio: float = 2.0,
+    refine_ratio: float = 2.0, refine_dataset=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Throughput-mode IVF-PQ search, grouped by LIST (the PQ counterpart
     of :func:`ivf_flat_search_grouped`; SURVEY.md §7 hard part №3).
@@ -365,23 +588,28 @@ def ivf_pq_search_grouped(
     ``refine_ratio`` > 1 rescores the top candidates with exact f32
     distances (HIGHEST precision), so returned distances are exact.
 
-    ``qcap`` caps queries per list (static shape), default 2x mean
-    occupancy; overflow pairs are dropped (tiny recall cost, same contract
-    as the flat grouped search).
+    ``qcap`` caps queries per list (static shape); overflow pairs are
+    dropped. Default (``qcap=None``): auto-sized from the actual probe
+    map so at most 2% of (query, probe) pairs drop, with any residual
+    logged — never silent (common.resolve_qcap). An explicit ``qcap`` is
+    taken as-is; audit it with common.probe_drop_stats.
+
+    ``refine_dataset``: caller-held (n, d) dataset enabling exact
+    refinement for codes-only (``store_raw=False``) indexes — see
+    :func:`ivf_pq_search`.
     """
-    from raft_tpu.spatial.ann.common import check_candidate_pool, default_qcap
+    from raft_tpu.spatial.ann.common import auto_qcap, check_candidate_pool
 
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
     errors.check_same_cols(q, index.centroids, "queries", "index")
     check_candidate_pool(k, n_probes, index.storage)
     n_lists = index.centroids.shape[0]
-    nq = q.shape[0]
+    probes = None
     if qcap is None:
-        qcap = default_qcap(nq, n_probes, n_lists)
+        qcap, probes = auto_qcap(q, index.centroids, n_lists, n_probes)
     list_block = max(1, min(list_block, n_lists))
-    while n_lists % list_block:
-        list_block -= 1
     return _pq_grouped_impl(
-        index, q, k, n_probes, qcap, list_block, refine_ratio
+        index, q, k, n_probes, qcap, list_block, refine_ratio,
+        refine_dataset=refine_dataset, probes=probes,
     )

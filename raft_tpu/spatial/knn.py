@@ -242,6 +242,12 @@ def brute_force_knn(
         "force fused, or drop the tuning args",
     )
 
+    if index_norms is not None and not isinstance(
+        index_norms, (list, tuple)
+    ):
+        # mirror the bare-array index form: a single norms vector wraps
+        # into the single-partition list
+        index_norms = [index_norms]
     errors.expects(
         index_norms is None or len(index_norms) == len(parts),
         "index_norms: %d norm vectors for %d partitions",

@@ -82,6 +82,79 @@ def test_ivf_pq_unrefined_recall(dataset):
     assert r > 0.6, r  # quantized: lossy but far above chance (10/2000)
 
 
+def test_ivf_pq_subsample_blocked_build(dataset):
+    """Large-n build path (subsampled training + streaming blocked encode)
+    must produce an index with recall comparable to the one-shot build."""
+    x, q = dataset
+    index = ivf_pq_build(
+        x,
+        IVFPQParams(
+            n_lists=16, pq_dim=8, seed=0,
+            train_size=600, encode_block=512,  # forces both paths
+        ),
+    )
+    d, i = ivf_pq_search(index, q, 10, n_probes=8)
+    _, bi = brute_force_knn(x, q, 10, metric="l2")
+    r = recall(np.asarray(i), np.asarray(bi))
+    assert r > 0.9, r
+    # codes cover every row exactly once: sorted ids are a permutation
+    ids = np.sort(np.asarray(index.storage.sorted_ids))
+    np.testing.assert_array_equal(ids, np.arange(len(x)))
+
+
+def test_ivf_pq_refine_dataset_external(dataset):
+    """store_raw=False + refine_dataset must match the store_raw=True
+    refined search (codes-only index memory, caller-held vectors)."""
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
+
+    x, q = dataset
+    p_raw = IVFPQParams(n_lists=16, pq_dim=8, seed=0, store_raw=True)
+    p_codes = IVFPQParams(n_lists=16, pq_dim=8, seed=0, store_raw=False)
+    idx_raw = ivf_pq_build(x, p_raw)
+    idx_codes = ivf_pq_build(x, p_codes)
+    d1, i1 = ivf_pq_search(idx_raw, q, 10, n_probes=8)
+    d2, i2 = ivf_pq_search(idx_codes, q, 10, n_probes=8, refine_dataset=x)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                               rtol=1e-5, atol=1e-5)
+    # grouped path too
+    g1, gi1 = ivf_pq_search_grouped(idx_raw, q, 10, n_probes=8, qcap=len(q))
+    g2, gi2 = ivf_pq_search_grouped(
+        idx_codes, q, 10, n_probes=8, qcap=len(q), refine_dataset=x
+    )
+    np.testing.assert_array_equal(np.asarray(gi1), np.asarray(gi2))
+
+
+def test_grouped_qcap_drop_accounting(dataset):
+    """Adversarially clustered queries (all probing the same lists) must
+    (a) be measurable via probe_drop_stats under a small explicit qcap and
+    (b) keep recall when qcap=None auto-sizes from the actual probe map."""
+    from raft_tpu.spatial.ann.common import (
+        coarse_probe, probe_drop_stats, resolve_qcap,
+    )
+    import jax.numpy as jnp
+
+    x, _ = dataset
+    # every query lands in the same blob -> one hot list
+    rng = np.random.default_rng(11)
+    hot = x[0] + 0.05 * rng.standard_normal((64, x.shape[1])).astype(
+        np.float32
+    )
+    index = ivf_flat_build(x, IVFFlatParams(n_lists=32, seed=0))
+    probes, _ = coarse_probe(
+        jnp.asarray(hot, jnp.float32), index.centroids, 4
+    )
+    stats = probe_drop_stats(probes, 32, qcap=8)
+    assert stats["dropped"] > 0 and stats["frac"] > 0.2, stats
+    # auto qcap resolves high enough that almost nothing drops
+    qcap = resolve_qcap(probes, 32, 64, 4)
+    assert probe_drop_stats(probes, 32, qcap)["frac"] <= 0.02
+    # and the auto-sized grouped search matches the per-query path
+    _, i_pq = ivf_flat_search(index, hot, 10, n_probes=4)
+    _, i_g = ivf_flat_search_grouped(index, hot, 10, n_probes=4)
+    assert recall(np.asarray(i_g), np.asarray(i_pq)) > 0.98
+
+
 def test_ivf_pq_refine_ratio_sweep(dataset):
     """Recall must be monotone-ish in refine_ratio and hit >=0.95 at 4x."""
     x, q = dataset
