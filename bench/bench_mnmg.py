@@ -33,7 +33,10 @@ if __name__ == "__main__" and "xla_force_host_platform_device_count" not in \
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if __name__ == "__main__":
+    # only the CLI entry forces CPU; importing this module must not
+    # silently retarget the host process's JAX platform
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _bytes_gb(b):

@@ -58,6 +58,14 @@ class KMeansParams:
     seed: int = 0
     init: str = "k-means++"  # "k-means++" | "random" | "array"
     block_rows: int = 1 << 16
+    # Operand dtype for the centroid-update matmul. None (default) keeps
+    # operands at the input dtype — the reference accumulates at input
+    # precision (detail/kmeans.cuh updateCentroids) and a silent bf16
+    # round would perturb every caller's centroids by ~1e-3 relative.
+    # "bfloat16" opts into 2x-MXU-rate updates (the IVF-PQ codebook /
+    # throughput regime, where intra-cluster averaging washes the
+    # rounding out).
+    compute_dtype: Optional[str] = None
 
 
 class KMeansOutput(NamedTuple):
@@ -67,9 +75,17 @@ class KMeansOutput(NamedTuple):
     n_iter: jax.Array      # scalar int32
 
 
-def _update_centroids(x, labels, k: int, block_rows: int):
-    """Blocked one-hot matmul centroid update; returns (sums (k,d), counts (k,))."""
+def _update_centroids(x, labels, k: int, block_rows: int,
+                      compute_dtype=None):
+    """Blocked one-hot matmul centroid update; returns (sums (k,d), counts (k,)).
+
+    ``compute_dtype=None``: operands at the input dtype (reference
+    precision, detail/kmeans.cuh updateCentroids); "bfloat16" opts into
+    2x-MXU-rate updates with f32 accumulation (~0.4%-relative operand
+    rounding that averages out over each cluster's members).
+    """
     m, d = x.shape
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
     bm = min(block_rows, m)
     nb = -(-m // bm)
     pad = nb * bm - m
@@ -77,16 +93,19 @@ def _update_centroids(x, labels, k: int, block_rows: int):
     # padded rows get label k and are sliced off the one-hot
     lp = jnp.pad(labels, (0, pad), constant_values=k)
 
+    # the XLA DEFAULT f32 matmul rounds operands to bf16 on TPU — exact
+    # input-precision updates therefore need HIGHEST explicitly
+    prec = (
+        lax.Precision.HIGHEST if jnp.dtype(cd).itemsize >= 4 else None
+    )
+
     def body(carry, blk):
         sums, counts = carry
         xb, lb = blk
-        # bf16 operands, f32 accumulation: 2x MXU rate; the 0.4%-relative
-        # operand rounding averages out over each cluster's members (the
-        # assign step already runs its gram at the same precision)
-        oh = jax.nn.one_hot(lb, k, dtype=jnp.bfloat16)     # (bm, k)
+        oh = jax.nn.one_hot(lb, k, dtype=cd)               # (bm, k)
         sums = sums + lax.dot_general(
-            oh, xb.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            oh, xb.astype(cd), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
         )
         counts = counts + jnp.sum(oh, axis=0, dtype=jnp.float32)
         return (sums, counts), None
@@ -125,9 +144,11 @@ def kmeans_plus_plus_init(x, k: int, key):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "max_iter", "tol", "block_rows")
+    jax.jit,
+    static_argnames=("k", "max_iter", "tol", "block_rows", "compute_dtype"),
 )
-def _lloyd(x, cents0, k: int, max_iter: int, tol: float, block_rows: int):
+def _lloyd(x, cents0, k: int, max_iter: int, tol: float, block_rows: int,
+           compute_dtype=None):
     m, d = x.shape
 
     def assign(cents):
@@ -161,7 +182,8 @@ def _lloyd(x, cents0, k: int, max_iter: int, tol: float, block_rows: int):
         # (m, k, d) pass per iteration just to refresh the residual.
         it, cents, _, res = state
         labels, minv = assign(cents)
-        sums, counts = _update_centroids(x, labels, k, block_rows)
+        sums, counts = _update_centroids(x, labels, k, block_rows,
+                                         compute_dtype)
         new_cents = sums / jnp.maximum(counts, 1.0)[:, None]
         new_cents = new_cents.astype(x.dtype)
         new_cents = reseed_empty(new_cents, counts, minv)
@@ -208,7 +230,7 @@ def kmeans_fit(
         cents0 = kmeans_plus_plus_init(x, params.n_clusters, key)
     return _lloyd(
         x, cents0, params.n_clusters, params.max_iter, params.tol,
-        params.block_rows,
+        params.block_rows, params.compute_dtype,
     )
 
 
@@ -247,7 +269,7 @@ def kmeans_fit_batched(xs, params: Optional[KMeansParams] = None, **kw):
     return jax.vmap(
         lambda x, c0: _lloyd(
             x, c0, params.n_clusters, params.max_iter, params.tol,
-            params.block_rows,
+            params.block_rows, params.compute_dtype,
         )
     )(xs, cents0)
 

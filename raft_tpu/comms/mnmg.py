@@ -202,7 +202,8 @@ def mnmg_kmeans_fit(
             labels, minv = assign(cents)
             labels_upd = jnp.where(valid, labels, k)  # padded rows -> dropped
             sums, counts = _update_centroids(
-                x_loc, labels_upd, k, params.block_rows
+                x_loc, labels_upd, k, params.block_rows,
+                params.compute_dtype,
             )
             sums = ax.allreduce(sums)
             counts = ax.allreduce(counts)

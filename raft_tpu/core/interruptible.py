@@ -95,7 +95,8 @@ class Interruptible:
         cls.get_token(thread_id).cancel()
 
     @classmethod
-    def synchronize(cls, x, *, poll_interval_s: float = 0.001) -> None:
+    def synchronize(cls, x, *, poll_interval_s: float = 0.001,
+                    max_poll_interval_s: float = 0.05) -> None:
         """Cancellable wait on a jax array / pytree.
 
         The exact analog of the reference's polling loop
@@ -106,13 +107,20 @@ class Interruptible:
         device work itself still completes (cancellation is cooperative,
         as in the reference). Leaves without ``is_ready`` (plain numpy /
         scalars) are treated as ready.
+
+        The poll interval backs off exponentially from
+        ``poll_interval_s`` toward ``max_poll_interval_s`` so a
+        multi-second kernel doesn't burn a host core in 1 ms wakeups;
+        cancellation latency stays bounded by the cap.
         """
         leaves = [
             leaf for leaf in jax.tree.leaves(x) if hasattr(leaf, "is_ready")
         ]
+        interval = poll_interval_s
         while True:
             cls.yield_now()
             leaves = [leaf for leaf in leaves if not leaf.is_ready()]
             if not leaves:
                 return
-            time.sleep(poll_interval_s)  # the std::this_thread::yield slot
+            time.sleep(interval)  # the std::this_thread::yield slot
+            interval = min(interval * 2.0, max_poll_interval_s)

@@ -20,7 +20,6 @@ raft_tpu.sparse.linalg or a dense gemv — mirroring how the reference takes
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -86,13 +85,14 @@ def _lanczos_extend(matvec, V, B, v_start, start: int, key):
     return V, B, v_next, beta_last
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("matvec", "n", "n_components", "ncv", "keep",
-                     "max_restarts", "smallest", "dtype"),
-)
 def _thick_restart_lanczos(matvec, n, n_components, ncv, keep, max_restarts,
                            tol, v0, smallest, dtype=jnp.float32):
+    # NOT jitted at this level: matvec would have to be a static argument,
+    # and every in-repo caller passes a per-call closure — each solve
+    # would retrace AND pin the closure (with its captured arrays) in the
+    # jit cache forever. The lax control flow below still compiles as
+    # single XLA computations; callers wanting cross-call caching can jit
+    # a wrapper with a stable matvec themselves.
     v0 = v0 / jnp.linalg.norm(v0)
     key = jax.random.PRNGKey(1811)               # breakdown-recovery seeds
     V0 = jnp.zeros((ncv, n), dtype)
@@ -189,12 +189,14 @@ def lanczos_solver(matvec: Callable, n: int, n_components: int,
     if ncv is None or ncv <= 0:
         ncv = min(n, max(4 * n_components + 1, 32))
     ncv = min(ncv, n)
-    if n_components > ncv - 1 and n > ncv:
+    if n_components > ncv - 2 and n > ncv:
         raise ValueError(
-            f"n_components={n_components} needs ncv > n_components "
-            f"(got ncv={ncv})"
+            f"n_components={n_components} needs ncv >= n_components + 2 "
+            f"for thick restart (got ncv={ncv})"
         )
-    keep = min(max(n_components + 1, min(2 * n_components, ncv - 2)),
+    # keep at least every wanted pair across restarts (discarding one
+    # re-derives it from scratch each cycle and stalls convergence)
+    keep = min(max(n_components, min(2 * n_components, ncv - 2)),
                max(ncv - 2, 1))
     steps_per_cycle = max(ncv - keep, 1)
     max_steps = max_iter if max_iter and max_iter > 0 else 100 * ncv

@@ -314,18 +314,34 @@ class SparseColBlockIndex:
     """Entries grouped by column block, sorted by (row, local col) within a
     block, padded per block to a common static capacity. Padding lands on a
     dummy row (row = n, lcol = col_block - 1, val = 0) so segment ids stay
-    sorted and padding adds zero."""
+    sorted and padding adds zero.
 
-    rows: jax.Array          # (ncb, cap_blk) int32
-    lcols: jax.Array         # (ncb, cap_blk) int32
-    vals: jax.Array          # (ncb, cap_blk) f32
+    ``rb_off[j, r]`` marks where index ROW block r begins within column
+    block j's sorted entries (row-sorted ⇒ each (col block × row block)
+    cell is one contiguous slice), so searches stream index row blocks —
+    a (cap_cell)-entry dynamic_slice per cell — and the documented
+    O(rows × col_block) memory bound holds for the build-once path too.
+    The entry arrays carry ``cap_cell`` rows of extra padding so cell
+    slices never clamp."""
+
+    rows: jax.Array          # (ncb, cap_blk + cap_cell) int32
+    lcols: jax.Array         # (ncb, cap_blk + cap_cell) int32
+    vals: jax.Array          # (ncb, cap_blk + cap_cell) f32
     counts: jax.Array        # (ncb,) int32 — live entries per block
+    rb_off: jax.Array        # (ncb, nrb + 1) int32 — row-block boundaries
     shape: Tuple[int, int] = dataclasses.field(metadata=dict(static=True))
     col_block: int = dataclasses.field(metadata=dict(static=True))
+    row_block: int = dataclasses.field(metadata=dict(static=True))
+    cap_cell: int = dataclasses.field(metadata=dict(static=True))
 
 
-def sparse_colblock_index_build(x, col_block: int = 4096) -> SparseColBlockIndex:
-    """Host-side build from a CSR, a scipy sparse matrix, or a dense array."""
+def sparse_colblock_index_build(
+    x, col_block: int = 4096, row_block: int = 4096
+) -> SparseColBlockIndex:
+    """Host-side build from a CSR, a scipy sparse matrix, or a dense array.
+
+    ``row_block`` fixes the search-time index-row streaming granularity
+    (the (m, row_block) distance-slab height)."""
     if isinstance(x, CSR):
         valid = np.asarray(x.valid_mask())
         rows = np.asarray(x.row_ids())[valid]
@@ -342,12 +358,14 @@ def sparse_colblock_index_build(x, col_block: int = 4096) -> SparseColBlockIndex
         vals = dense[rows, cols]
         shape = dense.shape
     n, d = shape
+    row_block = min(row_block, n)
     errors.expects(
-        (n + 1) * col_block < 2**31,
+        (max(n, row_block) + 1) * col_block < 2**31,
         "segment ids overflow int32: (n+1)*col_block = %d",
         (n + 1) * col_block,
     )
     ncb = max(-(-d // col_block), 1)
+    nrb = max(-(-n // row_block), 1)
     blk = cols // col_block
     lcols = cols - blk * col_block
     order = np.lexsort((lcols, rows, blk))
@@ -355,10 +373,19 @@ def sparse_colblock_index_build(x, col_block: int = 4096) -> SparseColBlockIndex
     counts = np.bincount(blk, minlength=ncb).astype(np.int32)
     cap = max(int(counts.max()) if len(counts) else 1, 1)
 
-    out_r = np.full((ncb, cap), n, np.int32)
-    out_c = np.full((ncb, cap), col_block - 1, np.int32)
-    out_v = np.zeros((ncb, cap), np.float32)
+    # per-(col block, row block) cell boundaries + the widest cell
     starts = np.concatenate([[0], np.cumsum(counts)])
+    rb_off = np.zeros((ncb, nrb + 1), np.int32)
+    for j in range(ncb):
+        s, e = starts[j], starts[j + 1]
+        rb_off[j] = np.searchsorted(
+            rows[s:e], np.arange(nrb + 1) * row_block, side="left"
+        ).astype(np.int32)
+    cap_cell = max(int(np.diff(rb_off, axis=1).max()) if rb_off.size else 1, 1)
+
+    out_r = np.full((ncb, cap + cap_cell), n, np.int32)
+    out_c = np.full((ncb, cap + cap_cell), col_block - 1, np.int32)
+    out_v = np.zeros((ncb, cap + cap_cell), np.float32)
     for j in range(ncb):
         s, e = starts[j], starts[j + 1]
         out_r[j, : e - s] = rows[s:e]
@@ -366,22 +393,30 @@ def sparse_colblock_index_build(x, col_block: int = 4096) -> SparseColBlockIndex
         out_v[j, : e - s] = vals[s:e]
     return SparseColBlockIndex(
         jnp.asarray(out_r), jnp.asarray(out_c), jnp.asarray(out_v),
-        jnp.asarray(counts), shape, col_block,
+        jnp.asarray(counts), jnp.asarray(rb_off), shape, col_block,
+        row_block, cap_cell,
     )
 
 
-def _layout_dists(layout: SparseColBlockIndex, a: CSR, metric, p,
-                  precision=None):
-    """(m, n) distances of CSR queries vs a prebuilt index. Index-side
-    densification per column block is a sorted segment-sum over just that
-    block's entries; query side is the masked scatter (queries arrive
-    dynamically, so no presort exists)."""
+def _layout_block_dists(layout: SparseColBlockIndex, a: CSR, metric, p,
+                        precision=None):
+    """Row-block-streaming distances of CSR queries vs a prebuilt index:
+    returns (one_nblock, nrb, bn) like :func:`_colblock_pair_dists` —
+    ``one_nblock(r)`` is the inf-padded (m, row_block) slab against index
+    row block r, accumulated over column blocks. Per cell the index side
+    is ONE (cap_cell)-entry dynamic_slice + sorted segment-sum (the
+    presort advantage of the build-once path); only
+    O(m·cb + row_block·cb + m·row_block) lives at once, so a 100k x 1M
+    search streams instead of materializing (m, n)."""
     metric = _canonicalize_colblock_metric(metric)
     f32 = jnp.float32
     m, d = a.shape
     n = layout.shape[0]
     cb = layout.col_block
+    bn = layout.row_block
+    cap_cell = layout.cap_cell
     ncb = layout.rows.shape[0]
+    nrb = layout.rb_off.shape[1] - 1
     expanded = metric in EXPANDED_METRICS
 
     spec = None
@@ -407,36 +442,62 @@ def _layout_dists(layout: SparseColBlockIndex, a: CSR, metric, p,
     flat_v = lvals.reshape(-1)
     bn_stats = zr.at[flat_r].add(flat_v * flat_v)[:n]
     bsum = zr.at[flat_r].add(flat_v)[:n]
+    nrpad = nrb * bn - n
+    bn_pad = jnp.pad(bn_stats, (0, max(nrpad, 0)))
+    bsum_pad = jnp.pad(bsum, (0, max(nrpad, 0)))
 
-    init, combine = _make_accumulators(expanded, spec, m, n)
+    def one_nblock(r):
+        r0 = r * bn
+        init, combine = _make_accumulators(expanded, spec, m, bn)
 
-    def body(accs, j):
-        c0 = j * cb
-        a_in = avalid & (a.indices >= c0) & (a.indices < c0 + cb)
+        def body(accs, j):
+            c0 = j * cb
+            a_in = avalid & (a.indices >= c0) & (a.indices < c0 + cb)
+            off = layout.rb_off[j, r]
+            cnt = layout.rb_off[j, r + 1] - off
+            if expanded:
+                occ = jnp.any(a_in) & (cnt > 0)
+            else:
+                occ = jnp.any(a_in) | (cnt > 0)
+
+            def live(accs):
+                da = _scatter_colblock(
+                    arows, a.indices, avals, a_in, m, c0, cb, f32
+                )
+                rr = lax.dynamic_slice(layout.rows[j], (off,), (cap_cell,))
+                lc = lax.dynamic_slice(layout.lcols[j], (off,), (cap_cell,))
+                vv = lax.dynamic_slice(lvals[j], (off,), (cap_cell,))
+                live_e = jnp.arange(cap_cell) < cnt
+                # masked tail -> the (bn, cb-1) junk segment: ids stay
+                # sorted (cell entries are (row, lcol)-sorted; bn > any
+                # live local row)
+                local = jnp.where(live_e, rr - r0, bn)
+                ids = local * cb + jnp.where(live_e, lc, cb - 1)
+                db = jax.ops.segment_sum(
+                    jnp.where(live_e, vv, 0.0), ids,
+                    num_segments=(bn + 1) * cb,
+                    indices_are_sorted=True,
+                ).reshape(bn + 1, cb)[:bn]
+                return _accumulate_block(
+                    expanded, spec, combine, accs, da, db, precision
+                )
+
+            return lax.cond(occ, live, lambda accs: accs, accs), None
+
+        accs, _ = lax.scan(body, init, jnp.arange(ncb))
         if expanded:
-            occ = jnp.any(a_in) & (layout.counts[j] > 0)
-        else:
-            occ = jnp.any(a_in) | (layout.counts[j] > 0)
-
-        def live(accs):
-            da = _scatter_colblock(arows, a.indices, avals, a_in, m, c0, cb, f32)
-            ids = layout.rows[j] * cb + layout.lcols[j]
-            db = jax.ops.segment_sum(
-                lvals[j], ids, num_segments=(n + 1) * cb,
-                indices_are_sorted=True,
-            ).reshape(n + 1, cb)[:n]
-            return _accumulate_block(
-                expanded, spec, combine, accs, da, db, precision
+            aa = asum if metric == DistanceType.HellingerExpanded else an
+            bslice = lax.dynamic_slice(bn_pad, (r0,), (bn,))
+            bsslice = lax.dynamic_slice(bsum_pad, (r0,), (bn,))
+            out = _expanded_from_gram(
+                metric, accs[0], aa, asum, bslice, bsslice, d
             )
+        else:
+            out = spec["fin"](accs, d, p)
+        cols = r0 + jnp.arange(bn)[None, :]
+        return jnp.where(cols < n, out, jnp.inf)
 
-        return lax.cond(occ, live, lambda accs: accs, accs), None
-
-    accs, _ = lax.scan(body, init, jnp.arange(ncb))
-    if expanded:
-        if metric == DistanceType.HellingerExpanded:
-            an = asum
-        return _expanded_from_gram(metric, accs[0], an, asum, bn_stats, bsum, d)
-    return spec["fin"](accs, d, p)
+    return one_nblock, nrb, bn
 
 
 @functools.partial(
@@ -473,7 +534,12 @@ def sparse_pairwise_distance(
             a.shape[1] == b.shape[1],
             "column mismatch: a has %d, index has %d", a.shape[1], b.shape[1],
         )
-        return _layout_dists(b, a, metric, p, precision)
+        one_nblock, nrb, bn = _layout_block_dists(b, a, metric, p, precision)
+        n = b.shape[0]
+        if nrb == 1:
+            return one_nblock(jnp.int32(0))[:, :n]
+        out = lax.map(one_nblock, jnp.arange(nrb))     # (nrb, m, bn)
+        return jnp.swapaxes(out, 0, 1).reshape(a.shape[0], nrb * bn)[:, :n]
     m, d = a.shape
     n = b.shape[0]
     errors.expects(
@@ -561,9 +627,29 @@ def sparse_brute_force_knn(
         queries.shape[1], index.shape[1],
     )
     if isinstance(index, SparseColBlockIndex):
-        dmat = _layout_dists(index, queries, metric, p, precision)
-        vals, idxs = lax.top_k(-dmat, k)
-        return -vals, idxs.astype(jnp.int32)
+        one_nblock, nrb, bn = _layout_block_dists(
+            index, queries, metric, p, precision
+        )
+        if nrb == 1:
+            dmat = one_nblock(jnp.int32(0))            # (m, bn) inf-padded
+            vals, idxs = lax.top_k(-dmat, min(k, bn))
+            return -vals, idxs.astype(jnp.int32)
+
+        def body(carry, r):
+            rv, ri = carry
+            dmat = one_nblock(r)                       # (m, bn) inf-padded
+            bv, bi = lax.top_k(-dmat, min(k, bn))
+            return (
+                merge_topk(rv, ri, -bv, bi + r * bn, select_min=True),
+                None,
+            )
+
+        init = (
+            jnp.full((m, k), jnp.inf, jnp.float32),
+            jnp.zeros((m, k), jnp.int32),
+        )
+        (vals, idxs), _ = lax.scan(body, init, jnp.arange(nrb))
+        return vals, idxs.astype(jnp.int32)
     errors.expects(
         strategy in ("auto", "dense", "colblock"),
         "unknown strategy %r (auto|dense|colblock)", strategy,

@@ -80,13 +80,39 @@ def approx_knn_search(
     per_query, grouped = entry
     nq = queries.shape[0]
 
+    def _params(fn):
+        return inspect.signature(
+            inspect.unwrap(getattr(fn, "__wrapped__", fn))
+        ).parameters
+
+    # a kwarg NEITHER path accepts is a user error (e.g. a refine_ratio
+    # typo) — silently dropping it would hide the mistake; a kwarg only
+    # the other mode accepts is legitimately ignored (logged, not fatal)
+    known = set(_params(per_query))
+    if grouped is not None:
+        known |= set(_params(grouped))
+    unknown = sorted(set(kw) - known)
+    errors.expects(
+        not unknown,
+        "approx_knn_search: unknown kwarg(s) %s (no search path accepts "
+        "them; valid tuning kwargs: %s)",
+        ", ".join(unknown), ", ".join(sorted(known - {"index", "queries", "k"})),
+    )
+
     def call(fn):
         # forward only the kwargs the chosen path accepts — auto dispatch
         # must not turn a valid call into a TypeError because the OTHER
         # path's tuning knob was supplied (block_q vs qcap/list_block)
-        params = inspect.signature(
-            inspect.unwrap(getattr(fn, "__wrapped__", fn))
-        ).parameters
+        params = _params(fn)
+        dropped = sorted(n for n in kw if n not in params)
+        if dropped:
+            from raft_tpu.core import logger
+
+            logger.info(
+                "approx_knn_search: kwarg(s) %s apply to the other search "
+                "mode and were ignored by the selected path",
+                ", ".join(dropped),
+            )
         return fn(
             index, queries, k, n_probes=n_probes,
             **{n: v for n, v in kw.items() if n in params},

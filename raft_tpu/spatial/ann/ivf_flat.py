@@ -67,6 +67,9 @@ def ivf_flat_build(x, params: IVFFlatParams = IVFFlatParams(), *,
             max_iter=params.kmeans_n_iters,
             seed=params.seed,
             init=params.kmeans_init,
+            # quantizer training tolerates bf16-rounded centroid updates
+            # (cluster averaging washes out operand rounding)
+            compute_dtype="bfloat16",
         ),
     )
     storage = build_list_storage(np.asarray(out.labels), params.n_lists)

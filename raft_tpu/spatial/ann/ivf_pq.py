@@ -151,6 +151,7 @@ def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
             max_iter=params.pq_kmeans_n_iters,
             seed=params.seed + 1,
             init=params.kmeans_init,
+            compute_dtype="bfloat16",
         ),
     )
     codebooks = outs.centroids                                # (M, K, ds)
@@ -223,6 +224,10 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
             max_iter=params.kmeans_n_iters,
             seed=params.seed,
             init=params.kmeans_init,
+            # quantizer training tolerates bf16-rounded centroid updates
+            # (intra-cluster averaging washes out operand rounding) and
+            # the 2x MXU rate matters at the 10M-build scale
+            compute_dtype="bfloat16",
         ),
     )
 
@@ -587,12 +592,21 @@ def ivf_pq_search_grouped(
     The bf16 one-hot contraction only affects ADC *candidate ranking*;
     ``refine_ratio`` > 1 rescores the top candidates with exact f32
     distances (HIGHEST precision), so returned distances are exact.
+    WITHOUT refinement (``refine_ratio <= 1``, or a codes-only index and
+    no ``refine_dataset``) the returned distances carry the bf16 ADC
+    rounding — coarser than :func:`ivf_pq_search`'s f32 per-query LUT
+    path, which ``approx_knn_search``'s auto mode may select at small
+    batch; pass an explicit ``mode=`` there if bit-stable unrefined
+    distances across batch sizes matter.
 
     ``qcap`` caps queries per list (static shape); overflow pairs are
     dropped. Default (``qcap=None``): auto-sized from the actual probe
     map so at most 2% of (query, probe) pairs drop, with any residual
-    logged — never silent (common.resolve_qcap). An explicit ``qcap`` is
-    taken as-is; audit it with common.probe_drop_stats.
+    logged — never silent (common.resolve_qcap). The auto path costs one
+    eager coarse probe + host sync per call, and a shifting query mix
+    that crosses a qcap doubling boundary recompiles the grouped
+    program — serving workloads that need fully-async dispatch should
+    pass an explicit ``qcap`` and audit it with common.probe_drop_stats.
 
     ``refine_dataset``: caller-held (n, d) dataset enabling exact
     refinement for codes-only (``store_raw=False``) indexes — see

@@ -48,6 +48,8 @@ def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
             n_clusters=params.n_lists,
             max_iter=params.kmeans_n_iters,
             seed=params.seed,
+            # quantizer training tolerates bf16-rounded centroid updates
+            compute_dtype="bfloat16",
         ),
     )
     vmin = jnp.min(x, axis=0)

@@ -354,6 +354,38 @@ def test_sparse_prebuilt_colblock_index(rng_np):
     np.testing.assert_allclose(np.asarray(pd), full, rtol=1e-4, atol=1e-3)
 
 
+def test_sparse_prebuilt_rowblocked_streaming(rng_np):
+    """row_block < n forces the index-row streaming path (the
+    O(rows x col_block) memory bound for build-once/search-many); results
+    must match the single-block layout exactly, per metric."""
+    from raft_tpu.sparse import sparse_colblock_index_build
+
+    d = 20_000
+    idx_sp = _scipy_rand(rng_np, 300, d, 30)
+    qry_sp = _scipy_rand(rng_np, 60, d, 30)
+    queries = csr_from_scipy(qry_sp)
+    one = sparse_colblock_index_build(idx_sp, col_block=4096)
+    blk = sparse_colblock_index_build(idx_sp, col_block=4096, row_block=64)
+    assert blk.rb_off.shape[1] - 1 == 5  # 5 streamed row blocks
+
+    for metric in ("sqeuclidean", "cosine", "l1", "hellinger"):
+        d1, i1 = sparse_brute_force_knn(one, queries, 7, metric=metric)
+        d2, i2 = sparse_brute_force_knn(blk, queries, 7, metric=metric)
+        np.testing.assert_allclose(
+            np.asarray(d1), np.asarray(d2), rtol=1e-5, atol=1e-5,
+            err_msg=metric,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(i1), np.asarray(i2), err_msg=metric
+        )
+        p1 = sparse_pairwise_distance(queries, one, metric)
+        p2 = sparse_pairwise_distance(queries, blk, metric)
+        np.testing.assert_allclose(
+            np.asarray(p1), np.asarray(p2), rtol=1e-5, atol=1e-5,
+            err_msg=metric,
+        )
+
+
 def test_sparse_colblock_index_build_from_csr(rng_np):
     from raft_tpu.sparse import sparse_colblock_index_build
 
