@@ -1,0 +1,119 @@
+"""float64 validation pass — run in its OWN process with x64 enabled
+(x64 is process-global config, so it cannot share the main test
+process). Exercises the places double precision matters in the
+reference (solvers, stats, LAP: double instantiations throughout
+cpp/src/): each check must beat tolerances unreachable in f32.
+
+Run: JAX_ENABLE_X64=1 JAX_PLATFORMS=cpu python -m tests.x64_checks
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_ENABLE_X64"] = "1"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def check_decomp():
+    from raft_tpu import linalg
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((60, 60))
+    sym = jnp.asarray((a + a.T) / 2, jnp.float64)
+    w, v = linalg.eig_dc(sym)
+    w_np = np.linalg.eigvalsh(np.asarray(sym))
+    assert np.allclose(np.asarray(w), w_np, atol=1e-12), "eig_dc f64"
+    r = np.asarray(sym @ v[:, 0] - w[0] * v[:, 0])
+    assert np.linalg.norm(r) < 1e-11, f"eig residual {np.linalg.norm(r)}"
+
+    b = jnp.asarray(rng.standard_normal((80, 20)), jnp.float64)
+    u, s, vt = linalg.svd_qr(b)
+    s_np = np.linalg.svd(np.asarray(b), compute_uv=False)
+    assert np.allclose(np.asarray(s), s_np, atol=1e-12), "svd f64"
+
+    y = jnp.asarray(rng.standard_normal((80,)), jnp.float64)
+    for solver in (linalg.lstsq_svd_qr, linalg.lstsq_eig, linalg.lstsq_qr):
+        wfit = solver(b, y)
+        ref = np.linalg.lstsq(np.asarray(b), np.asarray(y), rcond=None)[0]
+        assert np.allclose(np.asarray(wfit), ref, atol=1e-9), solver.__name__
+    print("decomp f64 ok")
+
+
+def check_lanczos():
+    from raft_tpu.linalg.lanczos import lanczos_solver
+
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((400, 400))
+    sym = (a + a.T) / 2
+    mv = lambda v: jnp.asarray(sym) @ v
+    w, vecs, res, it = lanczos_solver(
+        mv, 400, 3, ncv=40, tol=1e-12, dtype=jnp.float64, return_info=True
+    )
+    w_np = np.linalg.eigvalsh(sym)[:3]
+    # f64 + restarts: accuracy far beyond the f32 floor
+    assert np.allclose(np.asarray(w), w_np, atol=1e-10), (w, w_np)
+    print("lanczos f64 ok (restarts:", int(it), ")")
+
+
+def check_stats():
+    from raft_tpu.stats import summary
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((5000, 8)) * 1e6 + 3e8, jnp.float64)
+    mu = summary.mean(x)
+    sd = summary.stddev(x)
+    c = summary.cov(x)
+    x_np = np.asarray(x)
+    assert np.allclose(np.asarray(mu), x_np.mean(0), rtol=1e-12)
+    assert np.allclose(np.asarray(sd), x_np.std(0, ddof=1), rtol=1e-9)
+    assert np.allclose(np.asarray(c), np.cov(x_np.T), rtol=1e-8), "cov f64"
+    print("stats f64 ok")
+
+
+def check_lap():
+    from raft_tpu.lap import solve_lap
+    import itertools
+
+    rng = np.random.default_rng(3)
+    cost = jnp.asarray(rng.random((7, 7)), jnp.float64)
+    rows, cols = solve_lap(cost)
+    got = float(np.asarray(cost)[np.arange(7), np.asarray(cols)].sum())
+    best = min(
+        sum(np.asarray(cost)[i, p[i]] for i in range(7))
+        for p in itertools.permutations(range(7))
+    )
+    assert abs(got - best) < 1e-12, (got, best)
+    print("lap f64 ok")
+
+
+def check_rng():
+    from raft_tpu.random.rng import RngState, normal
+
+    v = normal(RngState(5), (200_000,), dtype=jnp.float64, mu=2.0, sigma=3.0)
+    assert v.dtype == jnp.float64
+    assert abs(float(jnp.mean(v)) - 2.0) < 0.05
+    assert abs(float(jnp.std(v)) - 3.0) < 0.05
+    print("rng f64 ok")
+
+
+def main():
+    check_decomp()
+    check_lanczos()
+    check_stats()
+    check_lap()
+    check_rng()
+    print("X64-PASS")
+
+
+if __name__ == "__main__":
+    main()
