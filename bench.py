@@ -204,9 +204,14 @@ def extra_ivf_pq():
     t0 = time.perf_counter()
     # 2048 lists halve the worst-case padded list length on 1000-blob data;
     # pq_dim=24 (4 dims/subspace) sharpens ADC on the near-isotropic
-    # intra-blob residuals: recall@10 0.95 at n_probes=16 (measured sweep)
+    # intra-blob residuals: recall@10 0.95 at n_probes=16 (measured sweep).
+    # max_list_cap=512 splits the one swollen list (uncapped max_list is
+    # 1500 vs a 244 mean): grouped compute scales with n_lists * max_list,
+    # and capping measured 10.9k vs 7.1k QPS at identical recall (r4
+    # sweep; docs/ivf_scale.md "Padded-list tax")
     pq = ivf_pq_build(x, IVFPQParams(
         n_lists=2048, pq_dim=24, kmeans_n_iters=10, kmeans_init="random",
+        max_list_cap=512,
     ))
     jax.block_until_ready(pq.centroids)
     build_s = time.perf_counter() - t0
@@ -243,6 +248,11 @@ def extra_ivf_pq():
         "unit": "QPS",
         "recall_at_10": round(hits / true_np.size, 4),
         "build_s": round(build_s, 2),
+        # r02->r03 bisect (r4): the 8660->7129 drop was runtime drift, not
+        # code — the r02 library remeasures at 5982 QPS on the r4 runtime
+        # vs 7140 for r03 code (docs/ivf_scale.md "Padded-list tax"); the
+        # r4 gain is the max_list_cap=512 split of the swollen 1500-row list
+        "note": "max_list_cap=512; r02 lib remeasured 5982 QPS on r4 runtime",
     }
 
 

@@ -441,10 +441,14 @@ def _gather_refine_rows(index, refine_dataset, rpos, f32):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "n_probes", "qcap", "list_block", "refine_ratio"),
+    static_argnames=(
+        "k", "n_probes", "qcap", "list_block", "refine_ratio",
+        "exact_selection", "approx_recall_target",
+    ),
 )
 def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
-                     refine_dataset=None, probes=None):
+                     refine_dataset=None, probes=None,
+                     exact_selection=False, approx_recall_target=0.95):
     from raft_tpu.spatial.ann.common import (
         coarse_probe, invert_probe_map, regroup_pairs, score_l2_candidates,
         select_candidates,
@@ -529,8 +533,13 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
         # candidate pool, and exact lax.top_k here measured ~14x the cost
         # of everything else in the block at the 10M shape. The UNREFINED
         # path keeps exact selection: its per-block picks ARE the results.
-        if refine:
-            vals, sel = lax.approx_min_k(d2, kk)             # (LB, qcap, kk)
+        # ``exact_selection`` restores exact candidate selection without
+        # disabling refinement; ``approx_recall_target`` tunes the
+        # approximate stages' per-call recall.
+        if refine and not exact_selection:
+            vals, sel = lax.approx_min_k(
+                d2, kk, recall_target=approx_recall_target
+            )                                                # (LB, qcap, kk)
         else:
             nv, sel = lax.top_k(-d2, kk)
             vals = -nv
@@ -561,7 +570,13 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
     # (pool selection rides the hardware approx top-k too — same
     # already-approximate-stage argument as the per-block selection)
     c = max(k, min(int(math.ceil(refine_ratio * k)), p * kk))
-    nadc, cpos = lax.approx_min_k(pv, c)                     # (nq, c)
+    if exact_selection:
+        nv, cpos = lax.top_k(-pv, c)
+        nadc = -nv                                           # min-k convention
+    else:
+        nadc, cpos = lax.approx_min_k(
+            pv, c, recall_target=approx_recall_target
+        )                                                    # (nq, c)
     adc = -nadc
     rpos = jnp.take_along_axis(pm, cpos.astype(jnp.int32), axis=1)
     raw = _gather_refine_rows(index, refine_dataset, rpos, f32)
@@ -575,6 +590,7 @@ def ivf_pq_search_grouped(
     index: IVFPQIndex, queries, k: int, *, n_probes: int = 8,
     qcap: typing.Optional[int] = None, list_block: int = 8,
     refine_ratio: float = 2.0, refine_dataset=None,
+    exact_selection: bool = False, approx_recall_target: float = 0.95,
 ) -> Tuple[jax.Array, jax.Array]:
     """Throughput-mode IVF-PQ search, grouped by LIST (the PQ counterpart
     of :func:`ivf_flat_search_grouped`; SURVEY.md §7 hard part №3).
@@ -611,6 +627,14 @@ def ivf_pq_search_grouped(
     ``refine_dataset``: caller-held (n, d) dataset enabling exact
     refinement for codes-only (``store_raw=False``) indexes — see
     :func:`ivf_pq_search`.
+
+    Candidate selection inside the REFINED path uses the TPU hardware
+    approximate top-k (``lax.approx_min_k``) at two stages (per-block and
+    pooled) — a throughput choice that slightly thins the ADC candidate
+    pool. ``exact_selection=True`` restores exact ``lax.top_k`` at both
+    stages without disabling refinement (the pre-r03 behavior);
+    ``approx_recall_target`` tunes the approximate stages instead
+    (default 0.95). Unrefined searches always select exactly.
     """
     from raft_tpu.spatial.ann.common import auto_qcap, check_candidate_pool
 
@@ -618,6 +642,10 @@ def ivf_pq_search_grouped(
     errors.check_matrix(q, "queries")
     errors.check_same_cols(q, index.centroids, "queries", "index")
     check_candidate_pool(k, n_probes, index.storage)
+    errors.expects(
+        0.0 < approx_recall_target <= 1.0,
+        "approx_recall_target=%s out of range (0, 1]", approx_recall_target,
+    )
     n_lists = index.centroids.shape[0]
     probes = None
     if qcap is None:
@@ -626,4 +654,6 @@ def ivf_pq_search_grouped(
     return _pq_grouped_impl(
         index, q, k, n_probes, qcap, list_block, refine_ratio,
         refine_dataset=refine_dataset, probes=probes,
+        exact_selection=exact_selection,
+        approx_recall_target=approx_recall_target,
     )

@@ -257,6 +257,33 @@ def test_ivf_pq_grouped_matches_per_query_recall(dataset):
     assert r2 > 0.85, r2
 
 
+def test_ivf_pq_grouped_exact_selection(dataset):
+    """exact_selection=True restores exact lax.top_k candidate selection
+    in the refined grouped path (the pre-approx_min_k behavior) without
+    disabling refinement — recall must match or beat the approx mode."""
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
+
+    x, q = dataset
+    pq = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=8))
+    bd, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    _, ia = ivf_pq_search_grouped(
+        pq, q, 10, n_probes=8, refine_ratio=4.0, qcap=q.shape[0]
+    )
+    de, ie = ivf_pq_search_grouped(
+        pq, q, 10, n_probes=8, refine_ratio=4.0, qcap=q.shape[0],
+        exact_selection=True,
+    )
+    ra = recall(np.asarray(ia), np.asarray(bi))
+    re = recall(np.asarray(ie), np.asarray(bi))
+    # approx_min_k's pool is not a strict subset of the exact pool, so
+    # exact mode is not mathematically >= approx — compare with slack and
+    # require an absolute floor like the neighboring tests
+    assert re >= ra - 0.05, (ra, re)
+    assert re > 0.85, re
+    # refined distances are exact f32 regardless of selection mode
+    assert np.all(np.isfinite(np.asarray(de)[:, 0]))
+
+
 def test_ivf_pq_grouped_unrefined(dataset):
     from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
 
