@@ -181,25 +181,15 @@ def extra_ivf_pq():
     recall@10 measures ~0.19 at the same settings for ANY inverted-file
     method — that is a property of the adversarial dataset, not the
     index (measured, see bench/bench_ann.py)."""
-    from raft_tpu.random import make_blobs
-    from raft_tpu.random.rng import RngState
     from raft_tpu.spatial.ann import (
         IVFPQParams, ivf_pq_build, ivf_pq_search_grouped,
     )
-    from raft_tpu.spatial.fused_knn import fused_l2_knn
+    from bench.common import ann_bench_dataset, recall_at_k
 
     n, d, nq, k = 500_000, 96, 4096, 10
-    key = jax.random.PRNGKey(2)
-    x, _ = make_blobs(n, d, n_clusters=1000, cluster_std=1.0,
-                      state=RngState(7))
-    # queries: perturbed dataset points (realistic: queries come from the
-    # same distribution as the corpus)
-    base = jax.random.choice(key, x, shape=(nq,), axis=0)
-    q = base + 0.3 * jax.random.normal(
-        jax.random.fold_in(key, 1), (nq, d), jnp.float32
-    )
-    _, true_ids = fused_l2_knn(q, x, k, metric=DistanceType.L2Expanded)
-    true_np = np.asarray(true_ids)
+    # shared config: queries are perturbed dataset points (realistic —
+    # queries come from the corpus distribution); ground truth exact
+    x, q, true_np = ann_bench_dataset(n, d, nq, k)
 
     t0 = time.perf_counter()
     # 2048 lists halve the worst-case padded list length on 1000-blob data;
@@ -237,16 +227,11 @@ def extra_ivf_pq():
     )
     if ms is None:
         return {"metric": "ivf_pq", "error": "timing jitter-dominated"}
-    got = np.asarray(search(q)[1])
-    hits = sum(
-        len(set(g.tolist()) & set(t.tolist()))
-        for g, t in zip(got, true_np)
-    )
     return {
         "metric": f"ivf_pq_grouped_refined_{n}x{d}_q{nq}_k{k}_p{n_probes}",
         "value": round(nq / (ms / 1e3), 1),
         "unit": "QPS",
-        "recall_at_10": round(hits / true_np.size, 4),
+        "recall_at_10": round(recall_at_k(search(q)[1], true_np), 4),
         "build_s": round(build_s, 2),
         # r02->r03 bisect (r4): the 8660->7129 drop was runtime drift, not
         # code — the r02 library remeasures at 5982 QPS on the r4 runtime
@@ -345,11 +330,59 @@ def extra_ivf_pq_10m():
     return out
 
 
+def extra_mnmg_ivf_pq():
+    """The sharded (multi-chip) IVF-PQ program measured on ONE chip — a
+    1-device mesh runs the full shard_map pipeline (global probe,
+    ownership routing, grouped ADC, shard-local refinement, allgather
+    merge), so this row prices the distributed machinery's overhead vs
+    the plain grouped search at the identical 500k x 96 config. Recall
+    parity with the multi-chip layout is asserted on an 8-device CPU mesh
+    in tests/test_mnmg_ivf.py; this is the real-hardware shard program.
+    """
+    from raft_tpu.comms import (
+        build_comms, mnmg_ivf_pq_build, mnmg_ivf_pq_search,
+    )
+    from raft_tpu.spatial.ann import IVFPQParams
+    from bench.common import ann_bench_dataset, recall_at_k
+
+    n, d, nq, k = 500_000, 96, 4096, 10
+    x, q, true_np = ann_bench_dataset(n, d, nq, k)
+
+    comms = build_comms(jax.devices()[:1])
+    t0 = time.perf_counter()
+    idx = mnmg_ivf_pq_build(comms, np.asarray(x), IVFPQParams(
+        n_lists=2048, pq_dim=24, kmeans_n_iters=10, kmeans_init="random",
+        max_list_cap=512,
+    ))
+    jax.block_until_ready(idx.codes_sorted)
+    build_s = time.perf_counter() - t0
+
+    def search(qq):
+        return mnmg_ivf_pq_search(
+            comms, idx, qq, k, n_probes=16, refine_ratio=4.0, qcap=256,
+        )
+
+    from bench.common import chained_dispatch_ms
+
+    float(jnp.sum(search(q)[0]))  # compile + warm
+    ms = chained_dispatch_ms(lambda salt: q * (1.0 + 1e-6 * salt), search)
+    if ms is None:
+        return {"metric": "mnmg_ivf_pq", "error": "timing jitter-dominated"}
+    return {
+        "metric": f"mnmg_ivf_pq_1chip_{n}x{d}_q{nq}_k{k}_p16",
+        "value": round(nq / (ms / 1e3), 1),
+        "unit": "QPS",
+        "recall_at_10": round(recall_at_k(search(q)[1], true_np), 4),
+        "build_s": round(build_s, 2),
+    }
+
+
 _EXTRAS = {
     "big_knn": extra_big_knn,
     "kmeans": extra_kmeans,
     "ivf_pq": extra_ivf_pq,
     "ivf_pq_10m": extra_ivf_pq_10m,
+    "mnmg_ivf_pq": extra_mnmg_ivf_pq,
 }
 
 

@@ -20,6 +20,11 @@ from raft_tpu.comms.comms import (
 from raft_tpu.comms import self_test
 from raft_tpu.comms.self_test import run_all_self_tests
 from raft_tpu.comms.mnmg import mnmg_knn, mnmg_kmeans_fit
+from raft_tpu.comms.mnmg_ivf import (
+    MnmgIVFPQIndex,
+    mnmg_ivf_pq_build,
+    mnmg_ivf_pq_search,
+)
 from raft_tpu.comms.ring import ring_knn, ring_pairwise_distance
 
 __all__ = [
@@ -35,6 +40,9 @@ __all__ = [
     "run_all_self_tests",
     "mnmg_knn",
     "mnmg_kmeans_fit",
+    "MnmgIVFPQIndex",
+    "mnmg_ivf_pq_build",
+    "mnmg_ivf_pq_search",
     "ring_knn",
     "ring_pairwise_distance",
 ]

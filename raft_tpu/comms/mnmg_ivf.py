@@ -1,0 +1,352 @@
+"""Multi-chip sharded IVF-PQ — the DEEP-100M regime (docs/ivf_scale.md).
+
+The reference carries 100M-row ANN through FAISS GpuIndexIVFPQ
+(cpp/include/raft/spatial/knn/detail/ann_quantized_faiss.cuh:115-206) and
+merges multi-partition results with ``knn_merge_parts``
+(knn_brute_force_faiss.cuh:289-368). Here the same capability is a mesh
+program:
+
+* **Shard lists, replicate quantizers.** Coarse centroids + PQ codebooks
+  (a few MB) replicate to every chip; the inverted lists shard by list id
+  (greedy LPT assignment balances rows/chip; ``max_list_cap`` bounds
+  skew). Each chip's shard is a complete single-chip inverted-list
+  layout: contiguous codes, shard-local raw vectors for refinement, and
+  ``sorted_ids`` carrying GLOBAL row ids.
+* **Queries replicate; lists never move.** Every chip probes the GLOBAL
+  centroid set (replicated compute — identical probes everywhere), keeps
+  the probes it owns, and runs the UNCHANGED single-chip grouped ADC
+  kernel (:func:`raft_tpu.spatial.ann.ivf_pq._pq_grouped_impl`) against
+  its shard — unowned probe slots route to an empty sentinel list.
+* **Merge is a k-way top-k.** One ``all_gather`` of the (nq, k)
+  per-chip results + ``select_k`` yields the global top-k on every chip
+  (the ``knn_merge_parts`` pattern, same as :func:`mnmg_knn`).
+
+Per-chip refinement rescores that chip's top-c ADC candidates against its
+OWN raw rows (lists and their vectors co-shard), so the merge sees exact
+f32 distances and no raw vector ever crosses the interconnect.
+Collectives per batch: one (nq, k) value + one (nq, k) id all_gather —
+trivial next to ADC compute (docs/ivf_scale.md "The 100M multi-chip
+design").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import typing
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu import errors
+from raft_tpu.comms.comms import Comms
+from raft_tpu.spatial.ann.common import ListStorage, auto_qcap, coarse_probe
+from raft_tpu.spatial.ann.ivf_pq import (
+    IVFPQIndex,
+    IVFPQParams,
+    _cdiv_host,
+    _pq_grouped_impl,
+    _split_oversized_lists,
+    _train_coarse,
+    _train_pq_and_encode_blocked,
+)
+from raft_tpu.spatial.selection import select_k
+
+__all__ = ["MnmgIVFPQIndex", "mnmg_ivf_pq_build", "mnmg_ivf_pq_search"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MnmgIVFPQIndex:
+    """List-sharded IVF-PQ index over a comms mesh.
+
+    Stacked arrays carry a leading mesh axis (one slab per chip, sharded
+    ``P(axis, ...)``); quantizers and the ownership maps are replicated.
+    ``sorted_ids`` hold GLOBAL row ids so per-chip results merge without
+    translation. Shards support the grouped (list-major) search only.
+    """
+
+    centroids: jax.Array       # (n_lists_g, d) replicated
+    codebooks: jax.Array       # (M, 2^bits, ds) replicated
+    owner: jax.Array           # (n_lists_g,) int32 — owning rank per list
+    local_id: jax.Array        # (n_lists_g,) int32 — list id on its owner
+    local_cents: jax.Array     # (P, nl_pad, d) — per-chip centroid slab
+    codes_sorted: jax.Array    # (P, n_pad + 1, M) uint8
+    vectors_sorted: typing.Optional[jax.Array]  # (P, n_pad + 1, d) | None
+    sorted_ids: jax.Array      # (P, n_pad) int32 GLOBAL row ids
+    list_offsets: jax.Array    # (P, nl_pad + 1) int32
+    list_sizes: jax.Array      # (P, nl_pad) int32
+    pq_dim: int = dataclasses.field(metadata=dict(static=True))
+    pq_bits: int = dataclasses.field(metadata=dict(static=True))
+    n_pad: int = dataclasses.field(metadata=dict(static=True))
+    nl_pad: int = dataclasses.field(metadata=dict(static=True))
+    max_list: int = dataclasses.field(metadata=dict(static=True))
+    n_rows: int = dataclasses.field(metadata=dict(static=True))
+
+
+def _lpt_assign(sizes: np.ndarray, n_ranks: int):
+    """Greedy longest-processing-time list→rank assignment: biggest list
+    to the least-loaded rank. Returns (owner (nl,), local_id (nl,),
+    rows_per_rank (P,), lists_per_rank (P,))."""
+    nl = sizes.shape[0]
+    owner = np.empty(nl, np.int32)
+    local_id = np.empty(nl, np.int32)
+    loads = np.zeros(n_ranks, np.int64)
+    counts = np.zeros(n_ranks, np.int32)
+    for l in np.argsort(-sizes, kind="stable"):
+        r = int(np.argmin(loads))
+        owner[l] = r
+        local_id[l] = counts[r]
+        loads[r] += int(sizes[l])
+        counts[r] += 1
+    return owner, local_id, loads, counts
+
+
+def mnmg_ivf_pq_build(
+    comms: Comms, x, params: IVFPQParams = IVFPQParams()
+) -> MnmgIVFPQIndex:
+    """Build a list-sharded IVF-PQ index across the comms mesh.
+
+    Training (coarse k-means + PQ codebooks) runs once on a global uniform
+    subsample — quantizer quality saturates far below shard size, the same
+    subsample-train recipe as the single-chip blocked build (and FAISS's
+    own ``train()``; reference ann_quantized_faiss.cuh:115-206). The full
+    dataset is then encoded in streaming blocks and the lists distributed
+    by greedy LPT so rows/chip balance even on skewed clusterings.
+    ``max_list_cap`` (auto here — padded-compute AND skew both scale with
+    the longest list) splits swollen lists before assignment.
+
+    ``store_raw=True`` co-shards each list's raw vectors with its codes,
+    enabling shard-local exact refinement at search time.
+    """
+    x = np.asarray(x)
+    errors.expects(
+        x.ndim == 2 and x.shape[0] >= 2,
+        "x: expected a (n >= 2, d) matrix, got shape %s", tuple(x.shape),
+    )
+    n, d = x.shape
+    M = params.pq_dim
+    errors.check_k(params.n_lists, n, "n_lists vs dataset rows")
+    errors.expects(d % M == 0, "d=%d not divisible by pq_dim=%d", d, M)
+    ds = d // M
+    n_codes = 1 << params.pq_bits
+    errors.expects(
+        n >= n_codes,
+        "n=%d rows cannot train %d-entry PQ codebooks (pq_bits=%d); "
+        "lower pq_bits", n, n_codes, params.pq_bits,
+    )
+    n_ranks = comms.size
+
+    # ---- global training subsample + coarse quantizer: the shared
+    # single-chip front (host-side subsample selection — x stays on host)
+    xt, coarse, _ = _train_coarse(x, params)
+
+    # ---- streaming encode of the full dataset (block-shaped programs)
+    labels, codes, codebooks = _train_pq_and_encode_blocked(
+        x, xt, coarse, params, ds, n_codes
+    )
+    labels_np = np.asarray(labels)
+    codes_np = np.asarray(codes)
+    cents = coarse.centroids
+
+    # ---- cap swollen lists (always on for the sharded build: the padded
+    # grouped compute AND the LPT balance both degrade with one long list)
+    cap = (
+        params.max_list_cap
+        if params.max_list_cap is not None
+        else max(256, 2 * _cdiv_host(n, params.n_lists))
+    )
+    if cap:
+        labels_np, cents = _split_oversized_lists(labels_np, cents, cap)
+    nl_g = cents.shape[0]
+    sizes = np.bincount(labels_np, minlength=nl_g)
+
+    # ---- list → rank assignment (LPT) + per-rank shard assembly
+    owner, local_id, rows_per, lists_per = _lpt_assign(sizes, n_ranks)
+    n_pad = max(int(rows_per.max()), 1)
+    nl_pad = int(lists_per.max()) + 1          # +1 empty sentinel list
+    max_list = max(int(sizes.max()), 1)
+
+    row_owner = owner[labels_np]
+    codes_sh = np.zeros((n_ranks, n_pad + 1, M), np.uint8)
+    vecs_sh = (
+        np.zeros((n_ranks, n_pad + 1, d), x.dtype)
+        if params.store_raw else None
+    )
+    sids_sh = np.zeros((n_ranks, n_pad), np.int32)
+    offs_sh = np.zeros((n_ranks, nl_pad + 1), np.int32)
+    szs_sh = np.zeros((n_ranks, nl_pad), np.int32)
+    lcents_sh = np.zeros((n_ranks, nl_pad, d), np.float32)
+    cents_np = np.asarray(cents, np.float32)
+
+    for r in range(n_ranks):
+        rows = np.nonzero(row_owner == r)[0].astype(np.int32)
+        lloc = local_id[labels_np[rows]]
+        order = np.argsort(lloc, kind="stable")
+        rows_sorted = rows[order]
+        n_r = rows_sorted.shape[0]
+        sz = np.bincount(lloc, minlength=nl_pad)[:nl_pad]
+        offs_sh[r] = np.concatenate([[0], np.cumsum(sz)]).astype(np.int32)
+        szs_sh[r, :] = sz
+        sids_sh[r, :n_r] = rows_sorted
+        codes_sh[r, :n_r] = codes_np[rows_sorted]
+        if vecs_sh is not None:
+            vecs_sh[r, :n_r] = x[rows_sorted]
+        mine = np.nonzero(owner == r)[0]
+        lcents_sh[r, local_id[mine]] = cents_np[mine]
+
+    # ---- place: slabs shard over the mesh axis, maps/quantizers replicate
+    def ax_spec(nd):
+        return NamedSharding(comms.mesh, P(comms.axis, *([None] * nd)))
+
+    rep = NamedSharding(comms.mesh, P())
+    put = jax.device_put
+    return MnmgIVFPQIndex(
+        centroids=put(cents_np, rep),
+        codebooks=put(np.asarray(codebooks), rep),
+        owner=put(owner, rep),
+        local_id=put(local_id, rep),
+        local_cents=put(lcents_sh, ax_spec(2)),
+        codes_sorted=put(codes_sh, ax_spec(2)),
+        vectors_sorted=(
+            put(vecs_sh, ax_spec(2)) if vecs_sh is not None else None
+        ),
+        sorted_ids=put(sids_sh, ax_spec(1)),
+        list_offsets=put(offs_sh, ax_spec(1)),
+        list_sizes=put(szs_sh, ax_spec(1)),
+        pq_dim=M,
+        pq_bits=params.pq_bits,
+        n_pad=n_pad,
+        nl_pad=nl_pad,
+        max_list=max_list,
+        n_rows=n,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_search(
+    comms: Comms, store_raw: bool, statics: tuple
+):
+    """Compile one shard_map search program per (mesh, static-config)."""
+    (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
+     approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list) = statics
+    ax = comms.device_comms()
+
+    def body(cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
+             loffs, lszs, q):
+        # sharded slabs arrive as (1, ...) blocks — drop the mesh axis
+        lcents, codes_s, sids = lcents[0], codes_s[0], sids[0]
+        loffs, lszs = loffs[0], lszs[0]
+        vecs = vecs_s[0] if store_raw else None
+        rank = lax.axis_index(ax.axis)
+
+        qf = q.astype(jnp.float32)
+        # replicated compute: identical global probes on every chip —
+        # queries never move, only the (nq, k) results do
+        probes_g, _ = coarse_probe(qf, cents, n_probes)      # (nq, p)
+        own = owner[probes_g] == rank
+        lp = jnp.where(
+            own, local_id[probes_g], jnp.int32(nl_pad - 1)   # sentinel list
+        )
+
+        storage = ListStorage(
+            sorted_ids=sids,
+            list_offsets=loffs,
+            list_index=jnp.zeros((1, 1), jnp.int32),  # grouped path unused
+            list_sizes=lszs,
+            n=n_pad,
+            max_list=max_list,
+        )
+        shard = IVFPQIndex(
+            centroids=lcents, codebooks=cbs, codes_sorted=codes_s,
+            storage=storage, vectors_sorted=vecs,
+            pq_dim=pq_dim, pq_bits=pq_bits,
+        )
+        # the UNCHANGED single-chip grouped kernel, probes pre-mapped to
+        # shard-local list ids; sorted_ids are global so ids need no
+        # translation downstream
+        vals, gids = _pq_grouped_impl(
+            shard, qf, k, n_probes, qcap, list_block, refine_ratio,
+            None, lp, exact_selection, approx_recall_target,
+        )
+        # k-way merge: one small all_gather pair + select_k
+        pd = ax.allgather(vals)                              # (P, nq, k)
+        pi = ax.allgather(gids)
+        nq = q.shape[0]
+        flat_d = pd.transpose(1, 0, 2).reshape(nq, -1)
+        flat_i = pi.transpose(1, 0, 2).reshape(nq, -1)
+        md, mi = select_k(flat_d, k, indices=flat_i)
+        mi = jnp.where(jnp.isfinite(md), mi, -1)
+        return md, mi
+
+    sharded = P(comms.axis, None, None)
+    sharded2 = P(comms.axis, None)
+    rep2 = P(None, None)
+    in_specs = (
+        rep2, P(None, None, None), P(None), P(None),
+        sharded, sharded,
+        sharded if store_raw else P(None, None, None),
+        sharded2, sharded2, sharded2, rep2,
+    )
+    sm = comms.shard_map(
+        body, in_specs=in_specs, out_specs=(rep2, rep2)
+    )
+    return jax.jit(sm)
+
+
+def mnmg_ivf_pq_search(
+    comms: Comms, index: MnmgIVFPQIndex, queries, k: int, *,
+    n_probes: int = 8, qcap: Optional[int] = None, list_block: int = 8,
+    refine_ratio: float = 2.0, exact_selection: bool = False,
+    approx_recall_target: float = 0.95,
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed grouped ADC search over a list-sharded index.
+
+    Returns (exact-refined squared L2 distances, GLOBAL row ids), both
+    (nq, k) and replicated on every chip. Semantics match
+    :func:`raft_tpu.spatial.ann.ivf_pq.ivf_pq_search_grouped` on the same
+    data — each probed list is searched by exactly one chip with the same
+    kernel, and per-chip top-c refinement pools are supersets of the
+    single-chip pool's per-list contributions, so recall parity holds
+    (tests/test_mnmg_ivf.py asserts it on an 8-device mesh).
+
+    ``qcap`` as in the single-chip grouped search; the ``None`` auto path
+    sizes it from the actual global probe map (one eager coarse probe +
+    host sync — pass an explicit qcap for async serving dispatch).
+    """
+    q = jnp.asarray(queries)
+    errors.check_matrix(q, "queries")
+    errors.check_same_cols(q, index.centroids, "queries", "index")
+    errors.expects(
+        k <= n_probes * index.max_list,
+        "k=%d exceeds the candidate pool (n_probes*max_list=%d)",
+        k, n_probes * index.max_list,
+    )
+    errors.expects(
+        0.0 < approx_recall_target <= 1.0,
+        "approx_recall_target=%s out of range (0, 1]", approx_recall_target,
+    )
+    nl_g = index.centroids.shape[0]
+    if qcap is None:
+        qcap, _ = auto_qcap(q, index.centroids, nl_g, n_probes)
+    list_block = max(1, min(list_block, index.nl_pad))
+    store_raw = index.vectors_sorted is not None
+    statics = (
+        k, n_probes, qcap, list_block, refine_ratio, exact_selection,
+        approx_recall_target, index.pq_dim, index.pq_bits, index.n_pad,
+        index.nl_pad, index.max_list,
+    )
+    fn = _cached_search(comms, store_raw, statics)
+    vecs = (
+        index.vectors_sorted if store_raw
+        else jnp.zeros((comms.size, 1, 1), jnp.float32)
+    )
+    return fn(
+        index.centroids, index.codebooks, index.owner, index.local_id,
+        index.local_cents, index.codes_sorted, vecs, index.sorted_ids,
+        index.list_offsets, index.list_sizes, q,
+    )

@@ -179,6 +179,55 @@ def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
     return labels, codes, codebooks
 
 
+def _train_coarse(x, params: IVFPQParams):
+    """Training-subsample selection + coarse quantizer fit — the shared
+    front of the single-chip and sharded (comms/mnmg_ivf.py) builds.
+
+    Large-n path (the DEEP-100M regime): train on a uniform subsample,
+    encode the full dataset later in streaming blocks — the same
+    train-on-subsample / add-in-batches structure FAISS uses under the
+    reference (ann_quantized_faiss.cuh:115-206 wraps GpuIndexIVFPQ whose
+    train() subsamples internally). One-shot training never needs more
+    rows than saturates quantizer quality.
+
+    ``x`` may be a host np.ndarray (the sharded build keeps the full
+    dataset on host): subsample selection then happens host-side so only
+    train_n rows ever materialize on device. Returns (xt, coarse, train_n).
+    """
+    n = x.shape[0]
+    train_n = min(
+        n,
+        params.train_size
+        if params.train_size is not None
+        else max(1 << 20, 64 * params.n_lists),
+    )
+    if train_n < n:
+        sel = jax.random.permutation(jax.random.PRNGKey(params.seed), n)[
+            :train_n
+        ]
+        if isinstance(x, np.ndarray):
+            xt = jnp.asarray(x[np.sort(np.asarray(sel))])
+        else:
+            xt = jnp.take(x, jnp.sort(sel), axis=0)
+    else:
+        xt = jnp.asarray(x)
+
+    coarse = kmeans_fit(
+        xt,
+        KMeansParams(
+            n_clusters=params.n_lists,
+            max_iter=params.kmeans_n_iters,
+            seed=params.seed,
+            init=params.kmeans_init,
+            # quantizer training tolerates bf16-rounded centroid updates
+            # (intra-cluster averaging washes out operand rounding) and
+            # the 2x MXU rate matters at the 10M-build scale
+            compute_dtype="bfloat16",
+        ),
+    )
+    return xt, coarse, train_n
+
+
 def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     x = jnp.asarray(x)
     errors.check_matrix(x, "x", min_rows=2)
@@ -194,42 +243,7 @@ def ivf_pq_build(x, params: IVFPQParams = IVFPQParams()) -> IVFPQIndex:
     ds = d // M
     n_codes = 1 << params.pq_bits
 
-    # Large-n build path (the DEEP-100M regime scaled to one chip): train
-    # the coarse quantizer and PQ codebooks on a uniform subsample, then
-    # encode the full dataset in streaming blocks — the same
-    # train-on-subsample / add-in-batches structure FAISS uses under the
-    # reference (ann_quantized_faiss.cuh:115-206 wraps GpuIndexIVFPQ whose
-    # train() subsamples internally). One-shot training never needs more
-    # rows than saturates quantizer quality.
-    train_n = min(
-        n,
-        params.train_size
-        if params.train_size is not None
-        else max(1 << 20, 64 * params.n_lists),
-    )
-    if train_n < n:
-        sel = jnp.sort(
-            jax.random.permutation(jax.random.PRNGKey(params.seed), n)[
-                :train_n
-            ]
-        )
-        xt = jnp.take(x, sel, axis=0)
-    else:
-        xt = x
-
-    coarse = kmeans_fit(
-        xt,
-        KMeansParams(
-            n_clusters=params.n_lists,
-            max_iter=params.kmeans_n_iters,
-            seed=params.seed,
-            init=params.kmeans_init,
-            # quantizer training tolerates bf16-rounded centroid updates
-            # (intra-cluster averaging washes out operand rounding) and
-            # the 2x MXU rate matters at the 10M-build scale
-            compute_dtype="bfloat16",
-        ),
-    )
+    xt, coarse, train_n = _train_coarse(x, params)
 
     blocked = train_n < n or n > params.encode_block
     if params.max_list_cap is not None:
