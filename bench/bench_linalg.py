@@ -1,0 +1,77 @@
+"""Elementwise / reduction primitive benchmarks — mirrors
+cpp/bench/linalg/{add,map_then_reduce,matrix_vector_op,reduce}.cu
+(shape grids from their *_input_vecs tables, scaled to one chip; the
+ragged +1 variants probe that unaligned tails do not collapse the
+bandwidth the way misaligned CUDA loads do)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bench.common import bench_fn
+from raft_tpu.linalg.elementwise import add, map_then_reduce
+from raft_tpu.linalg.matrix_vector import matrix_vector_op
+from raft_tpu.linalg.reduction import reduce
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # add.cu: 256Mi elements (x3 arrays = 3 GB) scaled to 64Mi + a ragged
+    # tail variant; bytes moved = 3 * len * 4 (two reads + one write)
+    for length in (64 * 1024 * 1024, 64 * 1024 * 1024 + 1):
+        a = jax.device_put(rng.standard_normal(length).astype(np.float32))
+        b = jax.device_put(rng.standard_normal(length).astype(np.float32))
+        bench_fn(
+            add, a, b, name=f"linalg/add/{length}",
+            work=3.0 * length * 4, unit="GB/s",
+        )
+
+    # map_then_reduce.cu: identity map + sum reduce
+    for length in (1024 * 1024, 32 * 1024 * 1024, 128 * 1024 * 1024):
+        x = jax.device_put(rng.standard_normal(length).astype(np.float32))
+        bench_fn(
+            lambda v: map_then_reduce(lambda e: e, v),
+            x, name=f"linalg/map_then_reduce/{length}",
+            work=float(length) * 4, unit="GB/s",
+        )
+
+    # matrix_vector_op.cu: rows x cols grid, broadcast along rows / cols
+    for rows in (1024, 1024 * 1024):
+        for cols in (128, 129):
+            m = jax.device_put(
+                rng.standard_normal((rows, cols)).astype(np.float32)
+            )
+            for along in (True, False):
+                v = jax.device_put(
+                    rng.standard_normal(cols if along else rows).astype(
+                        np.float32
+                    )
+                )
+                bench_fn(
+                    lambda mm, vv, _a=along: matrix_vector_op(
+                        mm, vv, jnp.add, along_rows=_a
+                    ),
+                    m, v,
+                    name=f"linalg/matrix_vector_op/{rows}x{cols}"
+                         f"/along_rows={along}",
+                    work=2.0 * rows * cols * 4, unit="GB/s",
+                )
+
+    # reduce.cu: kInputSizes grid, along rows and cols
+    for rows, cols in ((8192, 1024), (1024, 8192), (8192, 8192),
+                       (32 * 1024, 1024), (1024, 32 * 1024),
+                       (32 * 1024, 32 * 1024)):
+        x = jax.device_put(
+            rng.standard_normal((rows, cols)).astype(np.float32)
+        )
+        for axis in (0, 1):
+            bench_fn(
+                lambda v, _ax=axis: reduce(v, axis=_ax),
+                x, name=f"linalg/reduce/{rows}x{cols}/axis={axis}",
+                work=float(rows) * cols * 4, unit="GB/s",
+            )
+
+
+if __name__ == "__main__":
+    main()

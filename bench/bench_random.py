@@ -1,0 +1,76 @@
+"""RNG / generator benchmarks — mirrors cpp/bench/random/
+{rng,make_blobs,permute}.cu (the distribution sweep, the blobs grid, and
+the row-permute shapes).
+
+Harness note: bench/common.py defeats loop hoisting by perturbing FLOAT
+args per iteration, so every generator here takes a traced float ``t``
+folded into a distribution parameter (the key itself is static — the
+reference benches likewise reuse one generator state across iterations).
+"""
+
+import numpy as np
+import jax
+
+from bench.common import bench_fn
+from raft_tpu.random import make_blobs
+from raft_tpu.random.rng import (
+    RngState, exponential, fill, gumbel, laplace, logistic, lognormal,
+    normal, permute, rayleigh, uniform,
+)
+
+_S = RngState(7)
+
+
+def main():
+    # rng.cu distribution sweep at one large len; Gsamples/s
+    length = 32 * 1024 * 1024
+    dists = {
+        "uniform": lambda t: uniform(_S, (length,), low=t * 0),
+        "normal": lambda t: normal(_S, (length,), mu=t * 0),
+        "lognormal": lambda t: lognormal(_S, (length,), mu=t * 0),
+        "gumbel": lambda t: gumbel(_S, (length,), mu=t * 0),
+        "logistic": lambda t: logistic(_S, (length,), mu=t * 0),
+        "exp": lambda t: exponential(_S, (length,), lam=1.0 + t * 0),
+        "rayleigh": lambda t: rayleigh(_S, (length,), sigma=1.0 + t * 0),
+        "laplace": lambda t: laplace(_S, (length,), mu=t * 0),
+        "fill": lambda t: fill(_S, (length,), 3.0 + t * 0),
+    }
+    t0 = np.float32(0.0)
+    for name, gen in dists.items():
+        bench_fn(
+            gen, t0,
+            name=f"random/rng/{name}/{length}",
+            work=float(length), unit="Gsamples/s",
+        )
+
+    # make_blobs.cu grid (rows x cols x clusters)
+    for rows in (100_000, 1_000_000):
+        for cols in (10, 100):
+            for clusters in (2, 10, 100):
+                bench_fn(
+                    lambda t, _r=rows, _c=cols, _k=clusters: make_blobs(
+                        _r, _c, n_clusters=_k, state=_S,
+                        cluster_std=1.0 + t * 0,
+                    )[0],
+                    t0,
+                    name=f"random/make_blobs/{rows}x{cols}/k={clusters}",
+                    work=float(rows) * cols, unit="Gsamples/s",
+                )
+
+    # permute.cu: row permutation of an (n, d) matrix (perms + gathered
+    # copy, the needPerms=true + rowMajor variant)
+    rng_np = np.random.default_rng(0)
+    for rows in (32 * 1024, 1024 * 1024):
+        for cols in (128, 129):
+            x = jax.device_put(
+                rng_np.standard_normal((rows, cols)).astype(np.float32)
+            )
+            bench_fn(
+                lambda v: permute(_S, v.shape[0], x=v)[1],
+                x, name=f"random/permute/{rows}x{cols}",
+                work=2.0 * rows * cols * 4, unit="GB/s",
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,13 @@ run_tests() {
         python -m pytest tests/ -q
 }
 
+run_x64() {
+    # float64 pass in its OWN process — x64 is process-global config
+    # (the reference's double-instantiation niche, cpp/src/ *_d builds)
+    echo "== x64 checks (own process) =="
+    JAX_ENABLE_X64=1 JAX_PLATFORMS=cpu python -m tests.x64_checks
+}
+
 run_docs() {
     echo "== docs (API reference regenerates cleanly) =="
     JAX_PLATFORMS=cpu python docs/gen_api.py
@@ -49,8 +56,9 @@ run_docs() {
 case "$stage" in
     style) run_style ;;
     test) run_tests ;;
+    x64) run_x64 ;;
     docs) run_docs ;;
-    all) run_style; run_install_check; run_docs; run_tests ;;
-    *) echo "unknown stage: $stage (style|test|docs|all)"; exit 2 ;;
+    all) run_style; run_install_check; run_docs; run_x64; run_tests ;;
+    *) echo "unknown stage: $stage (style|test|x64|docs|all)"; exit 2 ;;
 esac
 echo "CI: OK"

@@ -48,7 +48,7 @@ def _auction_round(benefits, state: _AuctionState) -> _AuctionState:
     bid = best_val - second_val + state.eps
 
     # columns take the highest bid (max-scatter, ties to lowest row id)
-    big = jnp.float32(-jnp.inf)
+    big = jnp.asarray(-jnp.inf, benefits.dtype)
     col_bid = jnp.full((n,), big).at[best_col].max(
         jnp.where(unassigned, bid, big)
     )
@@ -83,9 +83,12 @@ def _auction_round(benefits, state: _AuctionState) -> _AuctionState:
 def solve_lap(cost, *, maximize: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Solve one n×n assignment. Returns (row_assignment (n,) int32, total
     objective) matching ``LinearAssignmentProblem::solve`` outputs
-    (row assignments + dual-feasible prices internally).
+    (row assignments + dual-feasible prices internally). Computation runs
+    in the cost dtype promoted to at least f32 (f64 under x64 — the
+    reference's double instantiation niche).
     """
-    cost = jnp.asarray(cost, jnp.float32)
+    cost = jnp.asarray(cost)
+    cost = cost.astype(jnp.promote_types(cost.dtype, jnp.float32))
     errors.check_matrix(cost, "cost")
     errors.expects(
         cost.shape[0] == cost.shape[1],
@@ -118,7 +121,7 @@ def solve_lap(cost, *, maximize: bool = False) -> Tuple[jax.Array, jax.Array]:
     eps_final = tol / n
     factor = jnp.exp(jnp.log(eps_final / eps0) / (n_phases - 1))
     epss = eps0 * factor ** jnp.arange(n_phases)
-    prices, states = lax.scan(scaled_phase, jnp.zeros((n,), jnp.float32), epss)
+    prices, states = lax.scan(scaled_phase, jnp.zeros((n,), cost.dtype), epss)
     row_to_col = states.row_to_col[-1]
     total = jnp.sum(cost[jnp.arange(n), row_to_col])
     return row_to_col, total
@@ -127,7 +130,7 @@ def solve_lap(cost, *, maximize: bool = False) -> Tuple[jax.Array, jax.Array]:
 def solve_lap_batched(costs, *, maximize: bool = False):
     """Batched assignment (reference lap.cuh batchsize dimension)."""
     return jax.vmap(lambda c: solve_lap(c, maximize=maximize))(
-        jnp.asarray(costs, jnp.float32)
+        jnp.asarray(costs)
     )
 
 

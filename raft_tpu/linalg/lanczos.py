@@ -189,10 +189,24 @@ def lanczos_solver(matvec: Callable, n: int, n_components: int,
     if ncv is None or ncv <= 0:
         ncv = min(n, max(4 * n_components + 1, 32))
     ncv = min(ncv, n)
-    if n_components > ncv - 2 and n > ncv:
-        raise ValueError(
-            f"n_components={n_components} needs ncv >= n_components + 2 "
-            f"for thick restart (got ncv={ncv})"
+    if n_components > ncv - 2:
+        if n > ncv:
+            raise ValueError(
+                f"n_components={n_components} needs ncv >= n_components + 2 "
+                f"for thick restart (got ncv={ncv})"
+            )
+        # full-width Krylov (ncv == n): one cycle is an exact
+        # tridiagonalization, but if it does NOT converge to tol, restart
+        # cycles can only retain ncv - 2 Ritz pairs — fewer than wanted —
+        # and may stall against the restart budget. Not silent.
+        from raft_tpu.core import logger
+
+        logger.warn(
+            "lanczos: n_components=%d exceeds ncv-2=%d at full Krylov "
+            "width (n=%d <= ncv); restarts retain only %d Ritz pairs and "
+            "convergence may stall — for this many pairs prefer a dense "
+            "eigendecomposition (linalg.eig_dc)",
+            n_components, ncv - 2, n, ncv - 2,
         )
     # keep at least every wanted pair across restarts (discarding one
     # re-derives it from scratch each cycle and stalls convergence)

@@ -205,8 +205,11 @@ def ivf_flat_search_grouped(
     than ``qcap`` queries drop the overflow. Default (``qcap=None``):
     auto-sized from the actual probe map so at most 2% of (query, probe)
     pairs drop, with any residual drop logged — never silent
-    (:func:`raft_tpu.spatial.ann.common.resolve_qcap`). An explicit
-    ``qcap`` is taken as-is; audit it with
+    (:func:`raft_tpu.spatial.ann.common.resolve_qcap`). The auto path
+    costs one eager coarse probe + host sync per call, and a shifting
+    query mix that crosses a qcap doubling boundary recompiles the
+    grouped program — serving workloads that need fully-async dispatch
+    should pass an explicit ``qcap`` (taken as-is) and audit it with
     :func:`raft_tpu.spatial.ann.common.probe_drop_stats`.
 
     Exactness: with ``qcap`` large enough this returns exactly what

@@ -272,15 +272,15 @@ def _fused_l2_knn_impl(
     # candidate chunk as one contiguous 128-row slab directly from the
     # index's native layout (no relayout copy, ~10x the XLA gather; see
     # _rescore_dma_kernel). Requires the padded candidate count to be a
-    # multiple of 8 (1-D output tiling) and the per-query grid to fit the
-    # compile helper's step budget; `gather_rows` explicitly pins the XLA
-    # fallback variants (exercised by tests).
+    # multiple of 8 (1-D output tiling); query batches beyond the
+    # per-call grid budget tile into <= grid_limit-row kernel calls so
+    # the throughput case (big m) keeps the DMA path. `gather_rows`
+    # explicitly pins the XLA fallback variants (exercised by tests).
     cpad = _round_up(c, 8)
     mp8 = _round_up(m, _QBLK)
     use_dma = (
         gather_rows is None
         and cpad <= nC
-        and mp8 <= grid_limit
         # Mosaic slab slices must be lane-aligned: narrower / ragged
         # feature dims take the XLA gather fallback (small-d regime,
         # where the chunk-major gather is cheap anyway)
@@ -290,9 +290,21 @@ def _fused_l2_knn_impl(
         _, cids = lax.top_k(-cmins, cpad)               # (m, cpad)
         qpad = q if mp8 == m else jnp.pad(q, ((0, mp8 - m), (0, 0)))
         cpds = cids if mp8 == m else jnp.pad(cids, ((0, mp8 - m), (0, 0)))
-        scores = _rescore_scores(
-            qpad, cpds.astype(jnp.int32), yp, c=cpad, interpret=interpret
-        )[:m]                                           # (m, cpad*128)
+        cpds = cpds.astype(jnp.int32)
+        # per-call tile bound: the compile-helper grid budget AND the
+        # scalar-prefetch SMEM footprint — the prefetched (rows, cpad)
+        # chunk-id operand costs round_up(cpad, 128)*4 bytes/row of the
+        # ~1 MiB SMEM (measured: 2000 rows compile at cpad=24, 2048 do
+        # not); budget 3/4 MiB to leave slack for Mosaic's own SMEM
+        smem_rows = (768 * 1024) // (_round_up(cpad, 128) * 4)
+        blk = max(_QBLK, min(grid_limit, smem_rows) // _QBLK * _QBLK)
+        scores = jnp.concatenate([
+            _rescore_scores(
+                qpad[s0:s0 + blk], cpds[s0:s0 + blk], yp,
+                c=cpad, interpret=interpret,
+            )
+            for s0 in range(0, mp8, blk)
+        ])[:m]                                          # (m, cpad*128)
         qn = jnp.sum(q * q, axis=-1)
         d2 = qn[:, None] + scores
         col = (cids[:, :, None] * _CHUNK

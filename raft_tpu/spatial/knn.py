@@ -253,6 +253,19 @@ def brute_force_knn(
         "index_norms: %d norm vectors for %d partitions",
         0 if index_norms is None else len(index_norms), len(parts),
     )
+    if index_norms is not None:
+        # mixed routing: norms tune only the fused kernel — a norms
+        # vector on a scan-routed partition quietly does nothing, so
+        # say so (the all-scan case errors above)
+        from raft_tpu.core import logger
+
+        for pi, (routed, nv) in enumerate(zip(routes, index_norms)):
+            if not routed and nv is not None:
+                logger.warn(
+                    "brute_force_knn: index_norms[%d] ignored — "
+                    "partition %d routes to the scan path (norms tune "
+                    "only the fused kernel)", pi, pi,
+                )
 
     def _search_part(pt, fused, norms):
         if fused:

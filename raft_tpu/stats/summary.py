@@ -84,15 +84,18 @@ def cov(x, mu=None, *, sample: bool = True, stable: bool = True):
     denom = n - 1 if sample else n
     if mu is None:
         mu = mean(x, axis=0)
+    # accumulate at least f32, but never DOWNCAST a wider input (f64 under
+    # x64 must keep f64 accumulation — the double-instantiation niche)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
     if stable:
         xc = x - mu[None, :]
         g = lax.dot_general(
             xc, xc, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc,
         )
         return g / denom
     g = lax.dot_general(
-        x, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        x, x, (((0,), (0,)), ((), ())), preferred_element_type=acc
     )
     return g / denom - jnp.outer(mu, mu) * (n / denom)
 

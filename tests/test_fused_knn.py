@@ -157,3 +157,23 @@ def test_fused_knn_warm_start(rng_np):
     got = np.sort(np.asarray(db), axis=1)
     want = np.sort(np.asarray(dfull), axis=1)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_knn_rescore_tiles_beyond_grid_limit(rng_np):
+    """Query batches whose padded row count exceeds the per-call grid
+    budget must keep the DMA rescore path by tiling into <= grid_limit
+    kernel calls (not silently fall back to the XLA gather)."""
+    from raft_tpu.spatial.fused_knn import _fused_l2_knn_impl
+
+    q = rng_np.standard_normal((40, 128)).astype(np.float32)
+    y = rng_np.standard_normal((4096, 128)).astype(np.float32)
+    dt, it = _fused_l2_knn_impl(
+        q, y, 5, DistanceType.L2SqrtExpanded, bm=1024, bn=2048, bq2=40,
+        extra_chunks=8, compute_dtype=jnp.dtype(jnp.float32),
+        interpret=True, grid_limit=16,    # forces ceil(40/16)=3 tiles
+    )
+    dref, iref = fused_l2_knn(q, y, 5)
+    np.testing.assert_array_equal(np.asarray(it), np.asarray(iref))
+    np.testing.assert_allclose(
+        np.asarray(dt), np.asarray(dref), rtol=1e-5, atol=1e-5
+    )

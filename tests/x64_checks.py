@@ -30,7 +30,7 @@ def check_decomp():
     rng = np.random.default_rng(0)
     a = rng.standard_normal((60, 60))
     sym = jnp.asarray((a + a.T) / 2, jnp.float64)
-    w, v = linalg.eig_dc(sym)
+    v, w = linalg.eig_dc(sym)    # (vectors, ascending values)
     w_np = np.linalg.eigvalsh(np.asarray(sym))
     assert np.allclose(np.asarray(w), w_np, atol=1e-12), "eig_dc f64"
     r = np.asarray(sym @ v[:, 0] - w[0] * v[:, 0])
@@ -86,8 +86,9 @@ def check_lap():
 
     rng = np.random.default_rng(3)
     cost = jnp.asarray(rng.random((7, 7)), jnp.float64)
-    rows, cols = solve_lap(cost)
-    got = float(np.asarray(cost)[np.arange(7), np.asarray(cols)].sum())
+    assign, obj = solve_lap(cost)   # (row_assignment, total objective)
+    got = float(np.asarray(cost)[np.arange(7), np.asarray(assign)].sum())
+    assert abs(float(obj) - got) < 1e-12, "objective computed in f64"
     best = min(
         sum(np.asarray(cost)[i, p[i]] for i in range(7))
         for p in itertools.permutations(range(7))
