@@ -19,7 +19,6 @@ Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
 
 import json
 import os
-import sys
 import time
 
 if __name__ == "__main__" and "xla_force_host_platform_device_count" not in \

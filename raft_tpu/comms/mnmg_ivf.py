@@ -301,7 +301,7 @@ def _cached_search(
 def mnmg_ivf_pq_search(
     comms: Comms, index: MnmgIVFPQIndex, queries, k: int, *,
     n_probes: int = 8, qcap: Optional[int] = None, list_block: int = 8,
-    refine_ratio: float = 2.0, exact_selection: bool = False,
+    refine_ratio: float = 2.0, exact_selection: bool = True,
     approx_recall_target: float = 0.95,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed grouped ADC search over a list-sharded index.
@@ -313,6 +313,14 @@ def mnmg_ivf_pq_search(
     kernel, and per-chip top-c refinement pools are supersets of the
     single-chip pool's per-list contributions, so recall parity holds
     (tests/test_mnmg_ivf.py asserts it on an 8-device mesh).
+
+    ``exact_selection`` defaults to True here (the single-chip grouped
+    search defaults to the hardware approx top-k): under shard_map's
+    manual partitioning the ApproxTopK custom call loses its fast TPU
+    lowering and measured 3.4x SLOWER than exact ``lax.top_k`` at the
+    500k x 96 bench shape (3350 vs 11558 QPS, identical recall —
+    docs/ivf_scale.md "The shard_map approx-top-k tax"). Set it False
+    only after measuring on your toolchain.
 
     ``qcap`` as in the single-chip grouped search; the ``None`` auto path
     sizes it from the actual global probe map (one eager coarse probe +

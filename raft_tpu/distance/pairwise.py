@@ -265,17 +265,26 @@ def _unexpanded_impl(metric, x, y, p, block_m):
 # ---------------------------------------------------------------------------
 
 
+def haversine_core(lat1, lon1, lat2, lon2):
+    """Elementwise great-circle distance on the unit sphere from radian
+    coordinates (broadcasting; the single formula shared by every
+    haversine layout — pairwise here, row-batched candidates in
+    spatial/ann/ball_cover.py). Reference haversine_distance.cuh:40-50."""
+    sin_lat = jnp.sin(0.5 * (lat1 - lat2))
+    sin_lon = jnp.sin(0.5 * (lon1 - lon2))
+    a = sin_lat**2 + jnp.cos(lat1) * jnp.cos(lat2) * sin_lon**2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
 def haversine_distance(x, y):
     """Pairwise haversine on (lat, lon) radian rows; returns the great-circle
     distance on the unit sphere (reference haversine_distance.cuh:40-50)."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
-    lat1, lon1 = x[:, 0][:, None], x[:, 1][:, None]
-    lat2, lon2 = y[:, 0][None, :], y[:, 1][None, :]
-    sin_lat = jnp.sin(0.5 * (lat1 - lat2))
-    sin_lon = jnp.sin(0.5 * (lon1 - lon2))
-    a = sin_lat**2 + jnp.cos(lat1) * jnp.cos(lat2) * sin_lon**2
-    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+    return haversine_core(
+        x[:, 0][:, None], x[:, 1][:, None],
+        y[:, 0][None, :], y[:, 1][None, :],
+    )
 
 
 # ---------------------------------------------------------------------------

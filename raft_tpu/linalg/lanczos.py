@@ -20,7 +20,7 @@ raft_tpu.sparse.linalg or a dense gemv — mirroring how the reference takes
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -189,6 +189,11 @@ def lanczos_solver(matvec: Callable, n: int, n_components: int,
     if ncv is None or ncv <= 0:
         ncv = min(n, max(4 * n_components + 1, 32))
     ncv = min(ncv, n)
+    if not (1 <= n_components <= n):
+        raise ValueError(
+            f"n_components={n_components} out of range [1, n={n}] — an "
+            f"n-dimensional operator has at most n eigenpairs"
+        )
     if n_components > ncv - 2:
         if n > ncv:
             raise ValueError(

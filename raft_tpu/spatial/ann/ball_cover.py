@@ -3,9 +3,18 @@ cpp/include/raft/spatial/knn/ball_cover.cuh:34-144 (``BallCoverIndex``
 ball_cover_common.h:38-90, rbc_build_index / rbc_knn_query /
 rbc_all_knn_query; registers kernels detail/ball_cover/registers.cuh).
 
-Build (reference rbc_build_index): sample √n landmarks (k-means refined),
-assign every point to its closest landmark (the "ball"), store balls with
-the shared sorted-list layout, record per-ball radii.
+Build (reference rbc_build_index): sample √n landmarks, assign every
+point to its closest landmark (the "ball"), store balls with the shared
+sorted-list layout, record per-ball radii.
+
+Metrics: the reference dispatches the whole pipeline on the index metric
+— ``HaversineFunc`` vs ``EuclideanFunc`` (ball_cover.cuh:38-42, 88-94,
+155); ball cover is largely *about* geospatial data. Here the same
+dispatch: ``metric="l2"`` (k-means-refined landmarks, squared-L2 probe
+with exact sqrt at the end) or ``metric="haversine"`` ((lat, lon) radian
+rows, data-point landmarks — Euclidean centroid averages are not
+meaningful in great-circle geometry — and haversine bounds throughout).
+Both are true metrics, so the same triangle-inequality machinery prunes.
 
 Query (reference's two-pass triangle-inequality strategy): balls are probed
 in order of d(q, landmark); a ball can contain a better neighbor only if
@@ -25,7 +34,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+from raft_tpu.distance.pairwise import haversine_core, haversine_distance
 from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
 
 __all__ = ["BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query"]
@@ -37,47 +48,90 @@ class BallCoverIndex:
     """Analog of BallCoverIndex (ball_cover_common.h:38)."""
 
     landmarks: jax.Array      # (n_landmarks, d)
-    radii: jax.Array          # (n_landmarks,)
+    radii: jax.Array          # (n_landmarks,) TRUE metric distances
     data_sorted: jax.Array    # (n + 1, d) sentinel row appended
     storage: ListStorage
+    metric: str = dataclasses.field(default="l2", metadata=dict(static=True))
 
 
-def rbc_build_index(x, *, n_landmarks: int = 0, seed: int = 0) -> BallCoverIndex:
+def _haversine_rows(q, cand, valid):
+    """Row-batched haversine: q (nq, 2) vs cand (nq, C, 2) radian pairs,
+    +inf where invalid (the haversine counterpart of
+    common.score_l2_candidates; formula shared via
+    distance.pairwise.haversine_core)."""
+    d = haversine_core(
+        q[:, 0][:, None], q[:, 1][:, None], cand[..., 0], cand[..., 1]
+    )
+    return jnp.where(valid, d, jnp.inf)
+
+
+def rbc_build_index(
+    x, *, n_landmarks: int = 0, seed: int = 0, metric: str = "l2"
+) -> BallCoverIndex:
     """Build (reference rbc_build_index, ball_cover.cuh:34): √n landmarks
-    by default."""
+    by default. ``metric="haversine"`` expects (lat, lon) RADIAN rows."""
     x = jnp.asarray(x)
+    errors.check_matrix(x, "x")
+    errors.expects(
+        metric in ("l2", "haversine"),
+        "metric must be 'l2' or 'haversine', got %r", metric,
+    )
     n = x.shape[0]
     if n_landmarks <= 0:
         n_landmarks = max(int(np.sqrt(n)), 1)
-    out = kmeans_fit(
-        x, KMeansParams(n_clusters=n_landmarks, max_iter=10, seed=seed)
-    )
-    labels = out.labels
-    storage = build_list_storage(np.asarray(labels), n_landmarks)
+
+    if metric == "haversine":
+        errors.expects(
+            x.shape[1] == 2,
+            "haversine expects (lat, lon) pairs, got %d columns", x.shape[1],
+        )
+        # landmarks are SAMPLED data points (the reference's random ball
+        # cover; Euclidean centroid averages are meaningless on the sphere)
+        sel = jax.random.choice(
+            jax.random.PRNGKey(seed), n, (min(n_landmarks, n),),
+            replace=False,
+        )
+        landmarks = jnp.take(x, jnp.sort(sel), axis=0)
+        hd = haversine_distance(x, landmarks)          # (n, L) true dists
+        labels = jnp.argmin(hd, axis=1)
+        member_d = jnp.min(hd, axis=1)
+    else:
+        out = kmeans_fit(
+            x, KMeansParams(n_clusters=n_landmarks, max_iter=10, seed=seed)
+        )
+        landmarks = out.centroids
+        labels = out.labels
+        member_d = jnp.sqrt(
+            jnp.maximum(
+                jnp.sum((x - landmarks[labels]) ** 2, axis=1), 0.0
+            )
+        )
+
+    storage = build_list_storage(np.asarray(labels), landmarks.shape[0])
     data_sorted = jnp.concatenate(
         [x[storage.sorted_ids], jnp.zeros((1, x.shape[1]), x.dtype)]
     )
-    # radius of each ball: max member distance to its landmark
-    d2 = jnp.sum((x - out.centroids[labels]) ** 2, axis=1)
-    radii = jnp.sqrt(
-        jnp.zeros((n_landmarks,), jnp.float32).at[labels].max(d2)
+    # radius of each ball: max member TRUE distance to its landmark
+    radii = jnp.zeros((landmarks.shape[0],), jnp.float32).at[labels].max(
+        member_d.astype(jnp.float32)
     )
-    return BallCoverIndex(out.centroids, radii, data_sorted, storage)
+    return BallCoverIndex(landmarks, radii, data_sorted, storage, metric)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes"))
 def rbc_knn_query(
     index: BallCoverIndex, queries, k: int, *, n_probes: int = 16
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """kNN query. Returns (dists (L2), ids, exact (nq,) bool certificate).
+    """kNN query. Returns (dists (true metric), ids, exact (nq,) bool
+    certificate).
 
     exact[i] is True when the triangle inequality proves no unprobed ball
     can contain a closer neighbor — the reference's pruning criterion
     (detail/ball_cover.cuh perform_post_filter_registers) used here as a
-    per-query certificate."""
+    per-query certificate. Valid for both metrics: L2 and great-circle
+    distance each satisfy the triangle inequality."""
     from raft_tpu.spatial.ann.common import (
-        check_candidate_pool, coarse_probe, score_l2_candidates,
-        select_candidates,
+        check_candidate_pool, score_l2_candidates, select_candidates,
     )
 
     q = jnp.asarray(queries)
@@ -87,14 +141,38 @@ def rbc_knn_query(
     check_candidate_pool(k, n_probes, index.storage)
     qf = q.astype(jnp.float32)
 
-    probes, ld2 = coarse_probe(qf, index.landmarks, n_probes)
-    ld = jnp.sqrt(jnp.maximum(ld2, 0.0))  # true landmark distances for the bound
+    if index.metric == "haversine":
+        all_ld = haversine_distance(qf, index.landmarks.astype(jnp.float32))
+        _, probes = jax.lax.top_k(-all_ld, n_probes)
+    else:
+        # landmark distances at HIGHEST precision (one matmul serves both
+        # probe selection and the certificate): the default-precision
+        # gram carries bf16 operand rounding on TPU, and a ~1e-3-relative
+        # error in d(q, L) could falsely certify a query whose margin is
+        # inside that band (the kth side comes from the exact scorer)
+        lm = index.landmarks.astype(jnp.float32)
+        g = jnp.einsum(
+            "qd,ld->ql", qf, lm, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ld2 = (
+            jnp.sum(qf * qf, axis=1)[:, None]
+            + jnp.sum(lm * lm, axis=1)[None, :]
+            - 2.0 * g
+        )
+        all_ld = jnp.sqrt(jnp.maximum(ld2, 0.0))       # (nq, n_land) true
+        _, probes = jax.lax.top_k(-ld2, n_probes)
 
     cand_pos = index.storage.list_index[probes].reshape(nq, -1)
     cand = index.data_sorted[cand_pos].astype(jnp.float32)
-    d2 = score_l2_candidates(qf, cand, cand_pos < index.storage.n)
-    vals, ids = select_candidates(index.storage, cand_pos, d2, k)
-    dists = jnp.sqrt(jnp.maximum(vals, 0.0))
+    valid = cand_pos < index.storage.n
+    if index.metric == "haversine":
+        dist = _haversine_rows(qf, cand, valid)
+        dists, ids = select_candidates(index.storage, cand_pos, dist, k)
+    else:
+        d2 = score_l2_candidates(qf, cand, valid)
+        vals, ids = select_candidates(index.storage, cand_pos, d2, k)
+        dists = jnp.sqrt(jnp.maximum(vals, 0.0))
 
     # exactness certificate: every UNPROBED ball satisfies
     # d(q, L) - radius_L >= kth  (probed balls were fully scored)
@@ -102,7 +180,7 @@ def rbc_knn_query(
     probed = jnp.zeros((nq, n_land), bool).at[
         jnp.arange(nq)[:, None], probes
     ].set(True)
-    bound = ld - index.radii[None, :]
+    bound = all_ld - index.radii[None, :]
     exact = jnp.all(probed | (bound >= kth[:, None]), axis=1)
     return dists, ids.astype(jnp.int32), exact
 

@@ -298,13 +298,27 @@ def _fused_l2_knn_impl(
         # not); budget 3/4 MiB to leave slack for Mosaic's own SMEM
         smem_rows = (768 * 1024) // (_round_up(cpad, 128) * 4)
         blk = max(_QBLK, min(grid_limit, smem_rows) // _QBLK * _QBLK)
-        scores = jnp.concatenate([
-            _rescore_scores(
-                qpad[s0:s0 + blk], cpds[s0:s0 + blk], yp,
-                c=cpad, interpret=interpret,
+        if mp8 <= blk:
+            scores = _rescore_scores(
+                qpad, cpds, yp, c=cpad, interpret=interpret
+            )[:m]
+        else:
+            # batches past the per-call budget run the SAME kernel via
+            # lax.map over uniform blk-row tiles: one compiled program
+            # regardless of m (an unrolled Python loop would emit one
+            # pallas_call per tile and blow up the HLO at large m)
+            tiles = _cdiv(mp8, blk)
+            pad2 = tiles * blk - mp8
+            qt = jnp.pad(qpad, ((0, pad2), (0, 0))).reshape(tiles, blk, d)
+            ct = jnp.pad(cpds, ((0, pad2), (0, 0))).reshape(
+                tiles, blk, cpad
             )
-            for s0 in range(0, mp8, blk)
-        ])[:m]                                          # (m, cpad*128)
+            scores = jax.lax.map(
+                lambda t: _rescore_scores(
+                    t[0], t[1], yp, c=cpad, interpret=interpret
+                ),
+                (qt, ct),
+            ).reshape(tiles * blk, cpad * _CHUNK)[:m]   # (m, cpad*128)
         qn = jnp.sum(q * q, axis=-1)
         d2 = qn[:, None] + scores
         col = (cids[:, :, None] * _CHUNK

@@ -239,6 +239,63 @@ def test_ball_cover_all_knn(dataset):
     np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(len(x)))
 
 
+@pytest.fixture(scope="module")
+def geo_dataset():
+    """(lat, lon) radian pairs clustered around world cities."""
+    rng = np.random.default_rng(4)
+    hubs = np.deg2rad(
+        rng.uniform([-60, -170], [70, 170], size=(25, 2))
+    ).astype(np.float32)
+    pts = hubs[rng.integers(0, 25, 3000)] + rng.normal(
+        0, 0.02, (3000, 2)
+    ).astype(np.float32)
+    pts[:, 0] = np.clip(pts[:, 0], -np.pi / 2, np.pi / 2)
+    q = pts[rng.integers(0, 3000, 200)] + rng.normal(
+        0, 0.01, (200, 2)
+    ).astype(np.float32)
+    q[:, 0] = np.clip(q[:, 0], -np.pi / 2, np.pi / 2)
+    return pts, q.astype(np.float32)
+
+
+def test_ball_cover_haversine_oracle(geo_dataset):
+    """Haversine ball cover vs the exact haversine_knn oracle — the
+    reference's geospatial dispatch (ball_cover.cuh:38-39, 88-94)."""
+    from raft_tpu.spatial.knn import haversine_knn
+
+    x, q = geo_dataset
+    index = rbc_build_index(x, n_landmarks=40, seed=1, metric="haversine")
+    assert index.metric == "haversine"
+    bd, bi = haversine_knn(x, q, 5)
+    d, i, exact = rbc_knn_query(index, q, 5, n_probes=40)
+    # full probing: exhaustively exact (the reference guarantee)
+    assert np.asarray(exact).all()
+    np.testing.assert_allclose(
+        np.asarray(d), np.asarray(bd), rtol=1e-5, atol=1e-6
+    )
+    assert recall(np.asarray(i), np.asarray(bi)) == 1.0
+
+
+def test_ball_cover_haversine_certificate(geo_dataset):
+    """Partial probing: certified queries must match the oracle exactly."""
+    from raft_tpu.spatial.knn import haversine_knn
+
+    x, q = geo_dataset
+    index = rbc_build_index(x, n_landmarks=40, seed=1, metric="haversine")
+    _, bi = haversine_knn(x, q, 5)
+    d, i, exact = rbc_knn_query(index, q, 5, n_probes=10)
+    ex = np.asarray(exact)
+    assert ex.mean() > 0.5, ex.mean()   # clustered geo data certifies fast
+    got, want = np.asarray(i)[ex], np.asarray(bi)[ex]
+    assert recall(got, want) == 1.0
+
+
+def test_ball_cover_haversine_validation():
+    with pytest.raises(Exception):
+        rbc_build_index(np.zeros((10, 3), np.float32), metric="haversine")
+    with pytest.raises(Exception):
+        rbc_build_index(np.zeros((10, 2), np.float32), metric="cosine")
+
+
 def test_ivf_pq_grouped_matches_per_query_recall(dataset):
     """List-major grouped PQ search (one-hot ADC matmul) must reach the
     per-query path's recall at the same n_probes/refine settings."""
