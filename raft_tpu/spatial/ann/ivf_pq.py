@@ -36,7 +36,11 @@ from jax import lax
 
 from raft_tpu import errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit, kmeans_predict
-from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
+from raft_tpu.spatial.ann.common import (
+    ListStorage,
+    build_list_storage,
+    split_oversized_lists as _split_oversized_lists,
+)
 
 __all__ = [
     "IVFPQParams", "IVFPQIndex", "ivf_pq_build", "ivf_pq_search",
@@ -96,32 +100,6 @@ def _cdiv_host(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _split_oversized_lists(labels_np, centroids, cap):
-    """Split every list longer than ``cap`` into contiguous sublists that
-    share the parent's centroid (appended as duplicate centroid rows).
-    Host-side, vectorized — build is offline. Returns (labels, centroids);
-    no-op when nothing exceeds the cap."""
-    n_lists = centroids.shape[0]
-    sizes = np.bincount(labels_np, minlength=n_lists)
-    extra = np.maximum(0, -(-sizes // cap) - 1)               # sublists - 1
-    if not extra.any():
-        return labels_np, centroids
-    order = np.argsort(labels_np, kind="stable")
-    lbl_sorted = labels_np[order]
-    offsets = np.concatenate([[0], np.cumsum(sizes)])
-    rank = np.arange(labels_np.shape[0]) - offsets[lbl_sorted]
-    sub = rank // cap                                         # 0..extra[l]
-    base = n_lists + np.concatenate([[0], np.cumsum(extra)[:-1]])
-    new_sorted = np.where(
-        sub == 0, lbl_sorted, base[lbl_sorted] + sub - 1
-    ).astype(labels_np.dtype)
-    out = np.empty_like(labels_np)
-    out[order] = new_sorted
-    dup = np.repeat(np.arange(n_lists), extra)
-    centroids = jnp.concatenate(
-        [centroids, jnp.take(centroids, jnp.asarray(dup), axis=0)]
-    )
-    return out, centroids
 
 
 def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):

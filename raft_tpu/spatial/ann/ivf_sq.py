@@ -10,6 +10,7 @@ with dequantization fused into the candidate scoring.
 from __future__ import annotations
 
 import dataclasses
+import typing
 import functools
 from typing import Tuple
 
@@ -18,7 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
-from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
+from raft_tpu.spatial.ann.common import (
+    ListStorage,
+    build_list_storage,
+    split_oversized_lists,
+)
 
 __all__ = ["IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search"]
 
@@ -28,6 +33,8 @@ class IVFSQParams:
     n_lists: int = 64
     kmeans_n_iters: int = 20
     seed: int = 0
+    # see IVFFlatParams.max_list_cap (common.split_oversized_lists)
+    max_list_cap: typing.Optional[int] = None
 
 
 @jax.tree_util.register_dataclass
@@ -58,11 +65,16 @@ def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
     codes = jnp.clip(
         jnp.round((x - vmin[None, :]) / vscale[None, :]) - 128, -128, 127
     ).astype(jnp.int8)
-    storage = build_list_storage(np.asarray(out.labels), params.n_lists)
+    labels_np, cents = np.asarray(out.labels), out.centroids
+    if params.max_list_cap:
+        labels_np, cents = split_oversized_lists(
+            labels_np, cents, params.max_list_cap
+        )
+    storage = build_list_storage(labels_np, cents.shape[0])
     codes_sorted = jnp.concatenate(
         [codes[storage.sorted_ids], jnp.zeros((1, x.shape[1]), jnp.int8)]
     )
-    return IVFSQIndex(out.centroids, codes_sorted, vmin, vscale, storage)
+    return IVFSQIndex(cents, codes_sorted, vmin, vscale, storage)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))

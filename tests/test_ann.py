@@ -289,6 +289,35 @@ def test_ball_cover_haversine_certificate(geo_dataset):
     assert recall(got, want) == 1.0
 
 
+def test_ivf_flat_sq_max_list_cap(dataset):
+    """max_list_cap splits swollen lists for Flat and SQ (the padded-list
+    tax fix, docs/ivf_scale.md); results stay exact for full probing."""
+    x, q = dataset
+    bd, bi = brute_force_knn(x, q, 5, metric="sqeuclidean")
+    flat = ivf_flat_build(
+        x, IVFFlatParams(n_lists=8, kmeans_n_iters=6, max_list_cap=64)
+    )
+    assert flat.storage.max_list <= 64
+    nl = flat.centroids.shape[0]
+    assert nl >= 8
+    _, fi = ivf_flat_search(flat, q, 5, n_probes=nl)
+    assert recall(np.asarray(fi), np.asarray(bi)) == 1.0
+    # grouped path handles the prime-ish post-split list count
+    from raft_tpu.spatial.ann import ivf_flat_search_grouped
+
+    _, gi = ivf_flat_search_grouped(
+        flat, q, 5, n_probes=nl, qcap=q.shape[0], list_block=32
+    )
+    assert recall(np.asarray(gi), np.asarray(bi)) == 1.0
+
+    sq = ivf_sq_build(
+        x, IVFSQParams(n_lists=8, kmeans_n_iters=6, max_list_cap=64)
+    )
+    assert sq.storage.max_list <= 64
+    _, si = ivf_sq_search(sq, q, 5, n_probes=sq.centroids.shape[0])
+    assert recall(np.asarray(si), np.asarray(bi)) > 0.9  # int8 rounding
+
+
 def test_ball_cover_haversine_validation():
     with pytest.raises(Exception):
         rbc_build_index(np.zeros((10, 3), np.float32), metric="haversine")
