@@ -46,7 +46,11 @@ def bench_weak_scaling():
     from raft_tpu.cluster.kmeans import KMeansParams
     from raft_tpu.comms.comms import Comms
     from raft_tpu.comms.mnmg import mnmg_kmeans_fit, mnmg_knn
+    from raft_tpu.comms.mnmg_ivf import (
+        mnmg_ivf_pq_build, mnmg_ivf_pq_search,
+    )
     from raft_tpu.comms.ring import ring_knn
+    from raft_tpu.spatial.ann import IVFPQParams
 
     devs = jax.devices()
     rows_per_dev, d, k_clusters, nq, topk = 16_384, 64, 64, 512, 10
@@ -106,6 +110,19 @@ def bench_weak_scaling():
 
         run_knn(mnmg_knn, "knn_allgather")
         run_knn(ring_knn, "knn_ring")
+
+        # ---- sharded IVF-PQ: lists shard, quantizers replicate -------
+        idx = mnmg_ivf_pq_build(comms, x, IVFPQParams(
+            n_lists=32, pq_dim=8, pq_bits=6, kmeans_n_iters=6, seed=0,
+        ))
+
+        def run_ivf(_c, _x, _q, _k):
+            return mnmg_ivf_pq_search(
+                _c, idx, _q, _k, n_probes=8, refine_ratio=4.0,
+                qcap=nq,
+            )
+
+        run_knn(run_ivf, "ivf_pq_sharded")
 
 
 def main():
