@@ -172,16 +172,28 @@ def extra_kmeans():
                            compute_dtype=compute_dtype)
         float(kmeans_fit(x, p5).inertia)      # compile both programs
         float(kmeans_fit(x, p20).inertia)
-        x2 = x * jnp.float32(1.0001)          # fresh values: no memoization
-        t0 = time.perf_counter()
-        out5 = kmeans_fit(x2, p5)
-        float(out5.inertia)
-        t5 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        out20 = kmeans_fit(x2, p20)
-        float(out20.inertia)
-        t20 = time.perf_counter() - t0
-        return (t20 - t5) / (int(out20.n_iter) - int(out5.n_iter))
+
+        def once(trial):
+            # fresh values each trial: defeat the axon result memoization
+            x2 = x * jnp.float32(1.0001 + 1e-5 * trial)
+            t0 = time.perf_counter()
+            out5 = kmeans_fit(x2, p5)
+            float(out5.inertia)
+            t5 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out20 = kmeans_fit(x2, p20)
+            float(out20.inertia)
+            t20 = time.perf_counter() - t0
+            return (t20 - t5) / (int(out20.n_iter) - int(out5.n_iter))
+
+        # the two-point difference is unsigned under host-timing noise
+        # (a contended dispatch can make t5 > t20 — observed once, BENCH
+        # r4 dry run at -371 iters/s); retry and take the median of the
+        # positive trials
+        vals = [v for v in (once(t) for t in range(3)) if v > 0]
+        if not vals:
+            raise RuntimeError("kmeans timing jitter-dominated")
+        return sorted(vals)[len(vals) // 2]
 
     exact = per_iter_s(None)
     bf16 = per_iter_s("bfloat16")

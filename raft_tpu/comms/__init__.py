@@ -24,6 +24,7 @@ from raft_tpu.comms.mnmg_ivf import (
     MnmgIVFPQIndex,
     mnmg_ivf_pq_build,
     mnmg_ivf_pq_search,
+    place_index,
 )
 from raft_tpu.comms.ring import ring_knn, ring_pairwise_distance
 
@@ -43,6 +44,7 @@ __all__ = [
     "MnmgIVFPQIndex",
     "mnmg_ivf_pq_build",
     "mnmg_ivf_pq_search",
+    "place_index",
     "ring_knn",
     "ring_pairwise_distance",
 ]

@@ -56,7 +56,10 @@ from raft_tpu.spatial.ann.ivf_pq import (
 )
 from raft_tpu.spatial.selection import select_k
 
-__all__ = ["MnmgIVFPQIndex", "mnmg_ivf_pq_build", "mnmg_ivf_pq_search"]
+__all__ = [
+    "MnmgIVFPQIndex", "mnmg_ivf_pq_build", "mnmg_ivf_pq_search",
+    "place_index",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -199,25 +202,19 @@ def mnmg_ivf_pq_build(
         mine = np.nonzero(owner == r)[0]
         lcents_sh[r, local_id[mine]] = cents_np[mine]
 
-    # ---- place: slabs shard over the mesh axis, maps/quantizers replicate
-    def ax_spec(nd):
-        return NamedSharding(comms.mesh, P(comms.axis, *([None] * nd)))
-
-    rep = NamedSharding(comms.mesh, P())
-    put = jax.device_put
-    return MnmgIVFPQIndex(
-        centroids=put(cents_np, rep),
-        codebooks=put(np.asarray(codebooks), rep),
-        owner=put(owner, rep),
-        local_id=put(local_id, rep),
-        local_cents=put(lcents_sh, ax_spec(2)),
-        codes_sorted=put(codes_sh, ax_spec(2)),
-        vectors_sorted=(
-            put(vecs_sh, ax_spec(2)) if vecs_sh is not None else None
-        ),
-        sorted_ids=put(sids_sh, ax_spec(1)),
-        list_offsets=put(offs_sh, ax_spec(1)),
-        list_sizes=put(szs_sh, ax_spec(1)),
+    # ---- place: slabs shard over the mesh axis, maps/quantizers
+    # replicate (single placement map, shared with deserialization)
+    host = MnmgIVFPQIndex(
+        centroids=cents_np,
+        codebooks=np.asarray(codebooks),
+        owner=owner,
+        local_id=local_id,
+        local_cents=lcents_sh,
+        codes_sorted=codes_sh,
+        vectors_sorted=vecs_sh,
+        sorted_ids=sids_sh,
+        list_offsets=offs_sh,
+        list_sizes=szs_sh,
         pq_dim=M,
         pq_bits=params.pq_bits,
         n_pad=n_pad,
@@ -225,6 +222,48 @@ def mnmg_ivf_pq_build(
         max_list=max_list,
         n_rows=n,
     )
+    return place_index(comms, host)
+
+
+# fields whose leading axis is the mesh axis; everything else replicates
+_SHARDED_FIELDS = frozenset({
+    "local_cents", "codes_sorted", "vectors_sorted", "sorted_ids",
+    "list_offsets", "list_sizes",
+})
+
+
+def field_sharding(comms: Comms, name: str, ndim: int):
+    """The NamedSharding :func:`mnmg_ivf_pq_build` gives each index field
+    (the single source of the field→sharding map; serialization streams
+    loaded slabs straight to it)."""
+    if name in _SHARDED_FIELDS:
+        return NamedSharding(
+            comms.mesh, P(comms.axis, *([None] * (ndim - 1)))
+        )
+    return NamedSharding(comms.mesh, P())
+
+
+def place_index(comms: Comms, index: MnmgIVFPQIndex) -> MnmgIVFPQIndex:
+    """(Re-)place a sharded index's arrays onto a comms mesh: slabs shard
+    over the mesh axis, quantizers and ownership maps replicate. Used by
+    :func:`mnmg_ivf_pq_build` itself and after
+    :func:`raft_tpu.spatial.ann.load_index`. The index must have been
+    built for the same mesh size (its slab leading axis)."""
+    n_ranks = index.codes_sorted.shape[0]
+    errors.expects(
+        n_ranks == comms.size,
+        "place_index: index built for %d ranks, mesh has %d",
+        n_ranks, comms.size,
+    )
+    kw = {}
+    for f in dataclasses.fields(MnmgIVFPQIndex):
+        v = getattr(index, f.name)
+        if v is not None and f.metadata.get("static") is None:
+            v = jax.device_put(
+                v, field_sharding(comms, f.name, np.ndim(v))
+            )
+        kw[f.name] = v
+    return MnmgIVFPQIndex(**kw)
 
 
 @functools.lru_cache(maxsize=32)

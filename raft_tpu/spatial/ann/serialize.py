@@ -39,6 +39,19 @@ _TYPES = {
     "ivf_sq": IVFSQIndex,
     "sparse_colblock": SparseColBlockIndex,
 }
+
+
+def _register_sharded() -> None:
+    # lazy: comms imports spatial.ann, so a top-level import here would
+    # be circular. The sharded index loads onto the default device;
+    # re-place onto a mesh with comms.mnmg_ivf.place_index before search.
+    if "mnmg_ivf_pq" not in _TYPES:
+        from raft_tpu.comms.mnmg_ivf import MnmgIVFPQIndex
+
+        _TYPES["mnmg_ivf_pq"] = MnmgIVFPQIndex
+        _NAMES[MnmgIVFPQIndex] = "mnmg_ivf_pq"
+
+
 _NAMES = {v: k for k, v in _TYPES.items()}
 # nested dataclasses that may appear inside an index payload
 _NESTED = {"ListStorage": ListStorage}
@@ -75,6 +88,8 @@ def _flatten(obj: Any, prefix: str, arrays: dict, static: dict) -> None:
 
 def save_index(index, path) -> None:
     """Serialize an ANN / sparse index to ``path`` (``.npz``)."""
+    if type(index) not in _NAMES:
+        _register_sharded()
     errors.expects(
         type(index) in _NAMES,
         "save_index: unsupported index type %s (supported: %s)",
@@ -101,7 +116,11 @@ def save_index(index, path) -> None:
         )
 
 
-def _rebuild(cls, prefix: str, npz, static: dict):
+def _default_placer(name, arr):
+    return jnp.asarray(arr)
+
+
+def _rebuild(cls, prefix: str, npz, static: dict, placer=_default_placer):
     kwargs = {}
     for f in dataclasses.fields(cls):
         key = f"{prefix}{f.name}"
@@ -112,7 +131,7 @@ def _rebuild(cls, prefix: str, npz, static: dict):
                 import ml_dtypes
 
                 arr = arr.view(np.dtype(getattr(ml_dtypes, tagged)))
-            kwargs[f.name] = jnp.asarray(arr)
+            kwargs[f.name] = placer(f.name, arr)
         else:
             v = static.get(key)
             if isinstance(v, dict) and "__nested__" in v:
@@ -129,9 +148,17 @@ def _rebuild(cls, prefix: str, npz, static: dict):
     return cls(**kwargs)
 
 
-def load_index(path):
+def load_index(path, comms=None):
     """Load an index saved by :func:`save_index`; arrays land on the
-    default device."""
+    default device.
+
+    ``comms``: for a sharded ``mnmg_ivf_pq`` index, stream each slab
+    DIRECTLY to its mesh placement as it is read — the 100M ``store_raw``
+    regime's raw-vector slabs exceed one chip's HBM, so materializing on
+    the default device first (then :func:`place_index`) would OOM exactly
+    where the sharded index matters. With ``comms=None`` such an index
+    loads onto the default device and needs
+    :func:`raft_tpu.comms.mnmg_ivf.place_index` before searching."""
     with np.load(path) as npz:
         header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
         errors.expects(
@@ -139,8 +166,22 @@ def load_index(path):
             "load_index: version %s unsupported (expected %d)",
             header.get("version"), _VERSION,
         )
+        if header.get("type") not in _TYPES:
+            _register_sharded()
         errors.expects(
             header.get("type") in _TYPES,
             "load_index: unknown index type %r", header.get("type"),
         )
-        return _rebuild(_TYPES[header["type"]], "", npz, header["static"])
+        placer = _default_placer
+        if comms is not None and header["type"] == "mnmg_ivf_pq":
+            import jax
+
+            from raft_tpu.comms.mnmg_ivf import field_sharding
+
+            def placer(name, arr):
+                return jax.device_put(
+                    arr, field_sharding(comms, name, arr.ndim)
+                )
+        return _rebuild(
+            _TYPES[header["type"]], "", npz, header["static"], placer
+        )

@@ -120,6 +120,35 @@ def test_codes_only_unrefined(dataset, comms):
     assert recall(np.asarray(ids), bi) > 0.5
 
 
+def test_sharded_index_serialization_roundtrip(tmp_path, dataset, comms,
+                                               sharded_index):
+    """save/load/place round-trip: identical search results after reload
+    (beyond-reference persistence extended to the sharded index)."""
+    from raft_tpu.comms.mnmg_ivf import place_index
+    from raft_tpu.spatial.ann import load_index, save_index
+
+    x, q, _ = dataset
+    p = tmp_path / "mnmg.npz"
+    save_index(sharded_index, p)
+    d1, i1 = mnmg_ivf_pq_search(
+        comms, sharded_index, q, 10, n_probes=16, refine_ratio=4.0,
+        qcap=q.shape[0]
+    )
+    # two load paths: default-device + place_index, and direct-to-mesh
+    # streaming (the 100M path where slabs exceed one device)
+    for loaded in (place_index(comms, load_index(p)),
+                   load_index(p, comms=comms)):
+        assert "ranks" in str(loaded.codes_sorted.sharding)
+        d2, i2 = mnmg_ivf_pq_search(
+            comms, loaded, q, 10, n_probes=16, refine_ratio=4.0,
+            qcap=q.shape[0]
+        )
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(
+            np.asarray(d1), np.asarray(d2), rtol=1e-6
+        )
+
+
 def test_fewer_lists_than_ranks(comms):
     """Ranks owning zero lists contribute inf and merge out."""
     x, _ = make_blobs(2_000, 16, n_clusters=4, state=RngState(2))
