@@ -176,9 +176,20 @@ def load_index(path, comms=None):
         if comms is not None and header["type"] == "mnmg_ivf_pq":
             import jax
 
-            from raft_tpu.comms.mnmg_ivf import field_sharding
+            from raft_tpu.comms.mnmg_ivf import (
+                _SHARDED_FIELDS, field_sharding,
+            )
 
             def placer(name, arr):
+                # mirror place_index's rank-count guard: a mismatched
+                # mesh whose size divides the slab axis would otherwise
+                # place silently and drop shards inside the search
+                errors.expects(
+                    name not in _SHARDED_FIELDS
+                    or arr.shape[0] == comms.size,
+                    "load_index: sharded index built for %d ranks, "
+                    "mesh has %d", arr.shape[0], comms.size,
+                )
                 return jax.device_put(
                     arr, field_sharding(comms, name, arr.ndim)
                 )

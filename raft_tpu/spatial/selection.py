@@ -37,7 +37,11 @@ class SelectKAlgo(enum.IntEnum):
     CHUNK_MIN = 3   # exact two-stage: chunk mins -> gather -> select
     APPROX = 4      # lax.approx_min_k — TPU PartialReduce hardware path,
                     # ~0.95 recall (memory-bandwidth-bound, ~7x faster
-                    # than TOPK on wide rows)
+                    # than TOPK on wide rows). CAVEAT: inside shard_map
+                    # (manual partitioning) the ApproxTopK custom call
+                    # loses this lowering and measured 3.4x SLOWER than
+                    # TOPK — prefer exact selection in mesh programs
+                    # (docs/ivf_scale.md "shard_map approx-top-k tax")
 
 
 def _resolve(algo: SelectKAlgo, n: int, k: int) -> SelectKAlgo:

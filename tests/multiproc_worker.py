@@ -13,6 +13,9 @@ rendezvous analog, reference comms.py:171-218 + nccl.pyx:52-57), then:
   1. runs every communicator round-trip self-test (comms/detail/test.hpp
      analog) on the 2x2-device global mesh;
   2. fits a small distributed k-means on a shared deterministic dataset;
+  3. builds + searches a list-sharded IVF-PQ index across the processes
+     (the DEEP-100M layout of comms/mnmg_ivf.py under REAL multi-host
+     jax.distributed, not just the single-process virtual mesh);
 
 and prints one JSON line with the results. The pytest driver
 (test_multiproc.py) spawns N of these and asserts cross-process agreement.
@@ -60,6 +63,21 @@ def main() -> None:
     )
     out = mnmg_kmeans_fit(comms, x, n_clusters=4, max_iter=20, seed=3)
 
+    # sharded IVF-PQ across the REAL process boundary: every rank holds
+    # the same host dataset (shared seed = the Dask client-scatter role);
+    # device_put scatters each rank's slab shards to its local devices
+    from raft_tpu.comms import mnmg_ivf_pq_build, mnmg_ivf_pq_search
+    from raft_tpu.spatial.ann import IVFPQParams
+
+    idx = mnmg_ivf_pq_build(comms, x, IVFPQParams(
+        n_lists=8, pq_dim=4, pq_bits=6, kmeans_n_iters=4, seed=0,
+    ))
+    dq, iq = mnmg_ivf_pq_search(
+        comms, idx, x[:16], 3, n_probes=8, refine_ratio=4.0, qcap=16,
+    )
+    iq_np = np.asarray(iq)
+    ivf_self = bool((iq_np[:, 0] == np.arange(16)).all())
+
     print(json.dumps({
         "rank": rank,
         "process_count": jax.process_count(),
@@ -68,6 +86,8 @@ def main() -> None:
         "inertia": float(out.inertia),
         "n_iter": int(out.n_iter),
         "centroid_sum": float(np.asarray(out.centroids, np.float64).sum()),
+        "ivf_self_recall": ivf_self,
+        "ivf_ids_sum": int(iq_np.sum()),
     }), flush=True)
 
 

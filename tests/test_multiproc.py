@@ -84,3 +84,13 @@ def test_mnmg_kmeans_agrees_across_processes(worker_reports):
     assert len(set(iters)) == 1
     # sanity: 4 well-separated blobs -> inertia far below total variance
     assert inertias[0] > 0.0
+
+
+def test_mnmg_ivf_pq_across_processes(worker_reports):
+    """Sharded IVF-PQ under real multi-process jax.distributed: every
+    rank must return exact self-neighbors and the identical merged ids
+    (replicated outputs agree across the process boundary)."""
+    for r in worker_reports:
+        assert r["ivf_self_recall"] is True, r
+    id_sums = {r["ivf_ids_sum"] for r in worker_reports}
+    assert len(id_sums) == 1, id_sums
