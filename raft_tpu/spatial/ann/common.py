@@ -166,6 +166,44 @@ def default_qcap(nq: int, n_probes: int, n_lists: int) -> int:
     return min(nq, -(-2 * mean_occ // 8) * 8)
 
 
+def throughput_qcap(nq: int, n_probes: int, n_lists: int) -> int:
+    """~0.75x the mean per-list probe occupancy, 8-aligned — the
+    throughput-mode cap (``qcap="throughput"``).
+
+    Grouped block compute is LINEAR in qcap, and slots fill in
+    probe-RANK order, so an aggressive cap drops only the marginal
+    last-rank (query, probe) pairs. Measured (docs/ivf_scale.md "The
+    qcap occupancy tax"): recall FLAT while QPS rose 11.2k -> 52.1k at
+    500k x 96 (knee at 0.75x mean) and 7.6k -> 12.7k at 10M x 96 (knee
+    at 0.75x mean again). NOT universally safe — on workloads whose hot
+    lists collect top-RANK probes the drops cost recall (the 3M x 768
+    diagnosis measured a 0.68 ceiling) — so it is opt-in; audit with
+    :func:`probe_drop_stats` + measured recall."""
+    mean_occ = max(1, (nq * n_probes + n_lists - 1) // n_lists)
+    # 8-align UPWARD: flooring could land 20-45% below the measured
+    # 0.75x-mean knee on non-divisible occupancies and silently cost
+    # the recall the benchmarks say is safe
+    return min(nq, max(8, -(-(3 * mean_occ // 4) // 8) * 8))
+
+
+def resolve_qcap_arg(qcap, q, centroids, n_lists: int, n_probes: int):
+    """Shared qcap-argument resolution of every grouped search entry
+    point: ``None`` -> the recall-safe auto path (:func:`auto_qcap`),
+    ``"throughput"`` -> :func:`throughput_qcap`, an integer -> as-is.
+    Returns (qcap int, probes_or_none)."""
+    from raft_tpu import errors
+
+    if qcap == "throughput":
+        return throughput_qcap(q.shape[0], n_probes, n_lists), None
+    if qcap is None:
+        return auto_qcap(q, centroids, n_lists, n_probes)
+    errors.expects(
+        isinstance(qcap, (int, np.integer)) and not isinstance(qcap, bool),
+        "qcap must be an int, None, or 'throughput'; got %r", qcap,
+    )
+    return int(qcap), None
+
+
 def probe_drop_stats(probes, n_lists: int, qcap: int):
     """Dropped (query, probe) pairs for a probe map under a ``qcap``:
     slots fill in probe-rank order, so exactly ``max(0, occupancy - qcap)``

@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import typing
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -209,7 +209,7 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None):
 
 def ivf_flat_search_grouped(
     index: IVFFlatIndex, queries, k: int, *, n_probes: int = 8,
-    qcap: Optional[int] = None, list_block: int = 32,
+    qcap: typing.Union[int, str, None] = None, list_block: int = 32,
 ) -> Tuple[jax.Array, jax.Array]:
     """Throughput-mode IVF search, grouped by LIST instead of by query —
     the query-side "sorted-by-list batching" (SURVEY.md §7 hard part №3).
@@ -233,6 +233,9 @@ def ivf_flat_search_grouped(
     grouped program — serving workloads that need fully-async dispatch
     should pass an explicit ``qcap`` (taken as-is) and audit it with
     :func:`raft_tpu.spatial.ann.common.probe_drop_stats`.
+    ``qcap="throughput"`` picks ~0.75x the mean probe occupancy — see
+    :func:`raft_tpu.spatial.ann.common.throughput_qcap` for when that
+    trade is and is not safe.
 
     Exactness: with ``qcap`` large enough this returns exactly what
     ``ivf_flat_search`` returns for the same ``n_probes`` (tested).
@@ -247,11 +250,11 @@ def ivf_flat_search_grouped(
     if not check:
         raise ValueError("k exceeds candidate pool; raise n_probes")
     n_lists = storage.list_index.shape[0]
-    probes = None
-    if qcap is None:
-        from raft_tpu.spatial.ann.common import auto_qcap
+    from raft_tpu.spatial.ann.common import resolve_qcap_arg
 
-        qcap, probes = auto_qcap(q, index.centroids, n_lists, n_probes)
+    qcap, probes = resolve_qcap_arg(
+        qcap, q, index.centroids, n_lists, n_probes
+    )
     list_block = max(1, min(list_block, n_lists))
     vals, ids = _grouped_impl(
         index, q, k, n_probes, qcap, list_block, probes=probes

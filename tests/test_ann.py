@@ -370,6 +370,35 @@ def test_ivf_pq_grouped_exact_selection(dataset):
     assert np.all(np.isfinite(np.asarray(de)[:, 0]))
 
 
+def test_grouped_throughput_qcap_mode(dataset):
+    """qcap="throughput" resolves to ~0.75x mean occupancy on PQ and
+    Flat grouped searches and keeps recall on clustered data."""
+    from raft_tpu.spatial.ann.common import throughput_qcap
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
+    from raft_tpu.spatial.ann import ivf_flat_search_grouped
+
+    x, q = dataset
+    assert throughput_qcap(4096, 16, 2048) == 24   # the measured knee
+    bd, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    pq = ivf_pq_build(x, IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=8))
+    _, i1 = ivf_pq_search_grouped(
+        pq, q, 10, n_probes=16, refine_ratio=4.0, qcap="throughput"
+    )
+    assert recall(np.asarray(i1), np.asarray(bi)) > 0.8
+    flat = ivf_flat_build(x, IVFFlatParams(n_lists=16, kmeans_n_iters=8))
+    _, i2 = ivf_flat_search_grouped(
+        flat, q, 10, n_probes=16, qcap="throughput"
+    )
+    assert recall(np.asarray(i2), np.asarray(bi)) > 0.8
+    # np integer caps are valid; bogus strings raise the typed error
+    _, i3 = ivf_pq_search_grouped(
+        pq, q, 10, n_probes=8, refine_ratio=4.0, qcap=np.int32(64)
+    )
+    assert recall(np.asarray(i3), np.asarray(bi)) > 0.8
+    with pytest.raises(ValueError):
+        ivf_pq_search_grouped(pq, q, 10, n_probes=8, qcap="bogus")
+
+
 def test_ivf_pq_grouped_unrefined(dataset):
     from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
 
