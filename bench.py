@@ -260,7 +260,7 @@ def extra_ivf_pq():
         # sweep; docs/ivf_scale.md "The qcap occupancy tax")
         return ivf_pq_search_grouped(
             index=pq, queries=qq, k=k, n_probes=n_probes,
-            refine_ratio=refine, qcap=24,
+            refine_ratio=refine, qcap="throughput",   # resolves to 24 here
         )
 
     # chained-dispatch two-point timing (same rationale as extra_big_knn:
@@ -351,7 +351,7 @@ def extra_ivf_pq_10m():
     # qcap=48 < the 64 mean occupancy: recall measured FLAT at 0.9668
     # for qcap 48..120 while QPS goes 7.6k -> 12.7k (r4 sweep;
     # docs/ivf_scale.md "The qcap occupancy tax")
-    n_probes, refine, qcap = 16, 8.0, 48
+    n_probes, refine, qcap = 16, 8.0, "throughput"   # resolves to 48 here
 
     def search(qq):
         return ivf_pq_search_grouped(
