@@ -456,21 +456,56 @@ _EXTRAS = {
 }
 
 
+def _current_round():
+    """The round being measured = the judged round in VERDICT.md + 1
+    (no VERDICT = round 1). Used to exclude this round's own artifact
+    from the regression reference: a re-run after the driver has already
+    written BENCH_r{N}.json must not stamp vs_prev against itself."""
+    import os.path
+    import re
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "VERDICT.md")
+    try:
+        with open(p) as f:
+            m = re.search(r"round\s+(\d+)", f.read(4096), re.IGNORECASE)
+        return int(m.group(1)) + 1 if m else None
+    except OSError:
+        # unreadable VERDICT (round 1 has none — but then no BENCH files
+        # exist either): fall through to the exclude-newest heuristic
+        # rather than silently disabling the regression reference
+        return None
+
+
 def _load_prev_bench():
-    """Latest BENCH_r*.json rows as {metric: value} — the per-round
-    regression reference (VERDICT r3: two double-digit regressions
-    shipped unnoticed because no round-over-round tracking existed)."""
+    """Latest prior-round BENCH_r*.json rows as {metric: value} — the
+    per-round regression reference (VERDICT r3: two double-digit
+    regressions shipped unnoticed because no round-over-round tracking
+    existed). Files sort NUMERICALLY on the round number (lexicographic
+    order breaks past r99) and the current round's own file is skipped."""
     import glob
     import os.path
+    import re
 
-    files = sorted(glob.glob(
+    cur = _current_round()
+    rounds = []
+    for p in glob.glob(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_r*.json")
-    ))
-    if not files:
+    ):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    if cur is not None:
+        rounds = [r for r in rounds if r[0] < cur]
+    elif rounds:
+        # unknown current round: assume the highest-numbered file IS this
+        # round's own artifact and exclude it — self-comparison always
+        # stamps vs_prev ~1.0 and masks regressions
+        rounds.remove(max(rounds))
+    if not rounds:
         return {}
     try:
-        with open(files[-1]) as f:
+        with open(max(rounds)[1]) as f:
             doc = json.load(f)
         row = doc.get("parsed", doc)
         prev = {row["metric"]: row["value"]}

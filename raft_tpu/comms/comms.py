@@ -307,8 +307,16 @@ class Comms:
         if mesh is not None:
             self.mesh = mesh
             self.axis = mesh.axis_names[0] if axis is None else axis
-            if self.axis not in mesh.axis_names:
-                self.axis = mesh.axis_names[0]
+            # a tuple axis (HierarchicalComms collectives span both mesh
+            # levels) is valid when every member names a mesh axis
+            names = mesh.axis_names
+            ok = (
+                all(a in names for a in self.axis)
+                if isinstance(self.axis, tuple)
+                else self.axis in names
+            )
+            if not ok:
+                self.axis = names[0]
         else:
             devs = list(devices) if devices is not None else jax.devices()
             self.mesh = jax.sharding.Mesh(np.array(devs), (axis,))

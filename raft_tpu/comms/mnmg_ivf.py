@@ -139,6 +139,11 @@ def mnmg_ivf_pq_build(
     M = params.pq_dim
     errors.check_k(params.n_lists, n, "n_lists vs dataset rows")
     errors.expects(d % M == 0, "d=%d not divisible by pq_dim=%d", d, M)
+    errors.expects(
+        1 <= params.pq_bits <= 8,
+        "pq_bits=%d out of range [1, 8] — codes are stored as uint8",
+        params.pq_bits,
+    )
     ds = d // M
     n_codes = 1 << params.pq_bits
     errors.expects(
@@ -272,11 +277,17 @@ def place_index(comms: Comms, index: MnmgIVFPQIndex) -> MnmgIVFPQIndex:
 
 @functools.lru_cache(maxsize=32)
 def _cached_search(
-    comms: Comms, store_raw: bool, statics: tuple
+    mesh: jax.sharding.Mesh, axis: str, store_raw: bool, statics: tuple
 ):
-    """Compile one shard_map search program per (mesh, static-config)."""
+    """Compile one shard_map search program per (mesh, static-config).
+
+    Keyed on (mesh, axis) — both value-hashable — rather than the Comms
+    object (identity-hashed): a caller constructing a fresh Comms per
+    search still hits the cached program, and the cache never retains
+    dead Comms instances."""
     (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
      approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list) = statics
+    comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
 
     def body(cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
@@ -394,7 +405,7 @@ def mnmg_ivf_pq_search(
         approx_recall_target, index.pq_dim, index.pq_bits, index.n_pad,
         index.nl_pad, index.max_list,
     )
-    fn = _cached_search(comms, store_raw, statics)
+    fn = _cached_search(comms.mesh, comms.axis, store_raw, statics)
     vecs = (
         index.vectors_sorted if store_raw
         else jnp.zeros((comms.size, 1, 1), jnp.float32)

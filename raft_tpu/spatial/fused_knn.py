@@ -278,6 +278,12 @@ def _fused_l2_knn_impl(
     # explicitly pins the XLA fallback variants (exercised by tests).
     cpad = _round_up(c, 8)
     mp8 = _round_up(m, _QBLK)
+    # per-call tile bound: the compile-helper grid budget AND the
+    # scalar-prefetch SMEM footprint — the prefetched (rows, cpad)
+    # chunk-id operand costs round_up(cpad, 128)*4 bytes/row of the
+    # ~1 MiB SMEM (measured: 2000 rows compile at cpad=24, 2048 do
+    # not); budget 3/4 MiB to leave slack for Mosaic's own SMEM
+    smem_rows = (768 * 1024) // (_round_up(cpad, 128) * 4)
     use_dma = (
         gather_rows is None
         and cpad <= nC
@@ -285,19 +291,20 @@ def _fused_l2_knn_impl(
         # feature dims take the XLA gather fallback (small-d regime,
         # where the chunk-major gather is cheap anyway)
         and d % _CHUNK == 0
+        # neither budget can hold even one _QBLK-row tile (very large
+        # cpad, or a caller-pinned tiny grid budget): take the XLA
+        # gather path rather than clamping the tile past the budget,
+        # which recreates the scalar-prefetch compile failure the
+        # tiling exists to avoid
+        and smem_rows >= _QBLK
+        and grid_limit >= _QBLK
     )
     if use_dma:
         _, cids = lax.top_k(-cmins, cpad)               # (m, cpad)
         qpad = q if mp8 == m else jnp.pad(q, ((0, mp8 - m), (0, 0)))
         cpds = cids if mp8 == m else jnp.pad(cids, ((0, mp8 - m), (0, 0)))
         cpds = cpds.astype(jnp.int32)
-        # per-call tile bound: the compile-helper grid budget AND the
-        # scalar-prefetch SMEM footprint — the prefetched (rows, cpad)
-        # chunk-id operand costs round_up(cpad, 128)*4 bytes/row of the
-        # ~1 MiB SMEM (measured: 2000 rows compile at cpad=24, 2048 do
-        # not); budget 3/4 MiB to leave slack for Mosaic's own SMEM
-        smem_rows = (768 * 1024) // (_round_up(cpad, 128) * 4)
-        blk = max(_QBLK, min(grid_limit, smem_rows) // _QBLK * _QBLK)
+        blk = min(grid_limit, smem_rows) // _QBLK * _QBLK
         if mp8 <= blk:
             scores = _rescore_scores(
                 qpad, cpds, yp, c=cpad, interpret=interpret
