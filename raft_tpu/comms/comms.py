@@ -151,6 +151,15 @@ class AxisComms:
         perm = [(i, (i + shift) % n) for i in range(n)]
         return lax.ppermute(x, self.axis, perm)
 
+    def alltoall(self, x):
+        """Each rank's ``x`` (size, chunk, ...) scatters chunk ``j`` to
+        rank ``j``; the result's slot ``s`` holds the chunk rank ``s``
+        sent here — ncclAllToAll / MPI_Alltoall shape (the reference
+        composes it from grouped p2p sends, std_comms.hpp:264-463; on TPU
+        it is one ICI-routed ``lax.all_to_all``). The row-exchange
+        backbone of the distributed index build (mnmg_ivf.py)."""
+        return lax.all_to_all(x, self.axis, split_axis=0, concat_axis=0)
+
     def p2p_batch(self) -> "P2PBatch":
         """Deferred tagged point-to-point batch — the analog of the
         reference's ``isend``/``irecv``/``waitall`` (core/comms.hpp:440-508,

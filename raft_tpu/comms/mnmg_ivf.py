@@ -44,6 +44,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu import errors
 from raft_tpu.comms.comms import Comms
+from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.spatial.ann.common import (
     ListStorage,
     coarse_probe,
@@ -53,16 +54,15 @@ from raft_tpu.spatial.ann.ivf_pq import (
     IVFPQIndex,
     IVFPQParams,
     _cdiv_host,
+    _encode_rows,
     _pq_grouped_impl,
-    _split_oversized_lists,
-    _train_coarse,
-    _train_pq_and_encode_blocked,
+    _train_pq_codebooks,
 )
 from raft_tpu.spatial.selection import select_k
 
 __all__ = [
-    "MnmgIVFPQIndex", "mnmg_ivf_pq_build", "mnmg_ivf_pq_search",
-    "place_index",
+    "MnmgIVFPQIndex", "mnmg_ivf_pq_build", "mnmg_ivf_pq_build_distributed",
+    "mnmg_ivf_pq_search", "place_index", "shard_rows",
 ]
 
 
@@ -116,28 +116,119 @@ def _lpt_assign(sizes: np.ndarray, n_ranks: int):
 def mnmg_ivf_pq_build(
     comms: Comms, x, params: IVFPQParams = IVFPQParams()
 ) -> MnmgIVFPQIndex:
-    """Build a list-sharded IVF-PQ index across the comms mesh.
+    """Build a list-sharded IVF-PQ index from ONE host array.
 
-    Training (coarse k-means + PQ codebooks) runs once on a global uniform
-    subsample — quantizer quality saturates far below shard size, the same
-    subsample-train recipe as the single-chip blocked build (and FAISS's
-    own ``train()``; reference ann_quantized_faiss.cuh:115-206). The full
-    dataset is then encoded in streaming blocks and the lists distributed
-    by greedy LPT so rows/chip balance even on skewed clusterings.
-    ``max_list_cap`` (auto here — padded-compute AND skew both scale with
-    the longest list) splits swollen lists before assignment.
-
-    ``store_raw=True`` co-shards each list's raw vectors with its codes,
-    enabling shard-local exact refinement at search time.
+    Convenience wrapper over :func:`mnmg_ivf_pq_build_distributed`: the
+    rows are placed onto the mesh one contiguous shard at a time (host
+    transient = one shard, never a second full copy), then the per-rank
+    distributed pipeline runs — training on a collectively-gathered
+    subsample, per-rank blocked encode, an ``all_to_all`` row exchange to
+    each list's LPT owner, and device-side slab assembly. In a
+    multi-process deployment each process transfers only the shards of
+    its own devices; processes whose data is genuinely local should call
+    the distributed entry point directly.
     """
     x = np.asarray(x)
     errors.expects(
         x.ndim == 2 and x.shape[0] >= 2,
         "x: expected a (n >= 2, d) matrix, got shape %s", tuple(x.shape),
     )
+    xg, n_valid = shard_rows(comms, x)
+    return mnmg_ivf_pq_build_distributed(comms, xg, params, n_valid=n_valid)
+
+
+def _P3(axis):
+    return P(axis, None, None)
+
+
+def shard_rows(comms: Comms, x: np.ndarray):
+    """Place a host (n, d) matrix as (P, n_loc, d) contiguous row shards
+    over the comms mesh — one ``device_put`` per (addressable) rank, so
+    the host transient is a single shard, never a second full copy.
+    Returns (sharded ``jax.Array``, ``n_valid`` (P,) int32) in the layout
+    :func:`mnmg_ivf_pq_build_distributed` consumes; shard row (r, j)
+    corresponds to global row ``r * n_loc + j``."""
     n, d = x.shape
+    Pn = comms.size
+    nloc = _cdiv_host(n, Pn)
+    sh = NamedSharding(comms.mesh, _P3(comms.axis))
+    parts = []
+    for r, dev in enumerate(comms.mesh.devices.flat):
+        if dev.process_index != jax.process_index():
+            continue
+        blk = x[r * nloc:min(n, (r + 1) * nloc)]
+        if blk.shape[0] < nloc:
+            blk = np.pad(blk, ((0, nloc - blk.shape[0]), (0, 0)))
+        parts.append(jax.device_put(blk[None], dev))
+    xg = jax.make_array_from_single_device_arrays((Pn, nloc, d), sh, parts)
+    n_valid = np.array(
+        [max(0, min(nloc, n - r * nloc)) for r in range(Pn)], np.int32
+    )
+    return xg, n_valid
+
+
+def mnmg_ivf_pq_build_distributed(
+    comms: Comms, x, params: IVFPQParams = IVFPQParams(), *,
+    n_valid=None,
+) -> MnmgIVFPQIndex:
+    """Build a list-sharded IVF-PQ index from PER-RANK row shards — no
+    host ever holds more than its own rows (the DEEP-100M build path;
+    VERDICT r4 item 1).
+
+    ``x``: (P, n_loc, d) stacked row shards, one slab per mesh rank,
+    sharded ``P(axis, None, None)`` (multi-process callers assemble it
+    with ``jax.make_array_from_process_local_data`` /
+    ``make_array_from_single_device_arrays`` from their local rows).
+    ``n_valid``: (P,) valid rows per rank (rows beyond are padding and
+    ignored); default all. Shard row ``(r, j)`` gets GLOBAL id
+    ``sum(n_valid[:r]) + j`` — contiguous block numbering, matching the
+    one-host wrapper's original row order.
+
+    Pipeline (each phase a mesh program; host touches only O(P·n_lists)
+    metadata):
+
+    1. **Subsample + train (replicated).** Every rank contributes
+       ``train_n / P`` uniformly-sampled local rows to one ``all_gather``
+       — the collective analog of FAISS's subsample ``train()``
+       (reference ann_quantized_faiss.cuh:115-206). Coarse k-means + PQ
+       codebooks then train on the replicated subsample, identically on
+       every rank.
+    2. **Per-rank blocked encode** (shard_map): each rank labels + PQ-
+       encodes ITS rows against the replicated quantizers in
+       ``encode_block``-row blocks; global list sizes come back from one
+       psum-sized allgather of the local bincounts.
+    3. **Device-side list split + LPT routing.** Oversized lists split by
+       GLOBAL within-list rank (per-rank prefix over the gathered count
+       matrix — same sublist semantics as the single-chip
+       ``split_oversized_lists``); the host computes the greedy-LPT
+       ``owner``/``local_id`` maps from the split sizes (O(n_lists)).
+    4. **Row exchange + slab assembly** (shard_map): every rank scatters
+       its rows into per-destination slots and a short sequence of
+       bounded-buffer ``all_to_all`` rounds (each padded to ~half a shard
+       of rows; typically 2 rounds balanced, more only under skew) routes
+       each list's rows to its owner — the ICI-native replacement for the
+       reference's host-mediated Dask worker-to-worker movement
+       (python/raft/raft/dask/common/comms.py:171-218). Each row carries
+       its exact destination slab position (derived from its global
+       within-list rank), so receivers scatter rows straight into the
+       contiguous slabs the grouped search kernel consumes — no
+       receive-side sort, no global-max-padded buffers.
+
+    ``store_raw=True`` co-shards each list's raw vectors with its codes
+    (shard-local exact refinement); with per-rank inputs the raw slab
+    only ever exists device-side.
+    """
+    errors.expects(
+        hasattr(x, "ndim") and x.ndim == 3,
+        "x: expected (n_ranks, n_loc, d) stacked row shards, got %s",
+        tuple(getattr(x, "shape", ())),
+    )
+    Pn, nloc, d = x.shape
+    errors.expects(
+        Pn == comms.size,
+        "x leading axis %d != mesh size %d", Pn, comms.size,
+    )
     M = params.pq_dim
-    errors.check_k(params.n_lists, n, "n_lists vs dataset rows")
     errors.expects(d % M == 0, "d=%d not divisible by pq_dim=%d", d, M)
     errors.expects(
         1 <= params.pq_bits <= 8,
@@ -146,82 +237,275 @@ def mnmg_ivf_pq_build(
     )
     ds = d // M
     n_codes = 1 << params.pq_bits
+    if n_valid is None:
+        n_valid = np.full(Pn, nloc, np.int32)
+    n_valid = np.asarray(n_valid, np.int32)
+    n = int(n_valid.sum())
+    errors.check_k(params.n_lists, n, "n_lists vs dataset rows")
     errors.expects(
         n >= n_codes,
         "n=%d rows cannot train %d-entry PQ codebooks (pq_bits=%d); "
         "lower pq_bits", n, n_codes, params.pq_bits,
     )
-    n_ranks = comms.size
+    nl = params.n_lists
+    ax = comms.device_comms()
+    sh3 = _P3(comms.axis)
+    sh2 = P(comms.axis, None)
+    sh1 = P(comms.axis)
+    rep = P()
 
-    # ---- global training subsample + coarse quantizer: the shared
-    # single-chip front (host-side subsample selection — x stays on host)
-    xt, coarse, _ = _train_coarse(x, params)
-
-    # ---- streaming encode of the full dataset (block-shaped programs)
-    labels, codes, codebooks = _train_pq_and_encode_blocked(
-        x, xt, coarse, params, ds, n_codes
+    # ---- phase 1: collective training subsample -> replicated quantizers
+    train_n = min(
+        n,
+        params.train_size
+        if params.train_size is not None
+        else max(1 << 20, 64 * nl),
     )
-    labels_np = np.asarray(labels)
-    codes_np = np.asarray(codes)
+    # quota per NON-EMPTY rank: empty shards are filtered from the gather
+    # below, so splitting the budget across all P ranks would shrink the
+    # training set below train_n (and below the n_lists/2^pq_bits minima
+    # the global-n guards above already validated)
+    keep = np.nonzero(n_valid > 0)[0]
+    t_per = _cdiv_host(train_n, max(keep.size, 1))
+    key0 = jax.random.PRNGKey(params.seed)
+
+    def sub_body(x_sh, nv_sh):
+        xb, nvr = x_sh[0], nv_sh[0]
+        key = jax.random.fold_in(key0, ax.get_rank())
+        # a random permutation prefix: exact without-replacement sampling
+        # on full shards (t_per == n_loc covers every row); ragged shards
+        # remap the out-of-range picks with a modulo (mild duplication)
+        sel = jax.random.permutation(key, nloc)[:t_per]
+        sel = jnp.where(sel < nvr, sel, sel % jnp.maximum(nvr, 1))
+        return ax.allgather(jnp.take(xb, sel, axis=0))       # (P, t_per, d)
+
+    sub = jax.jit(comms.shard_map(
+        sub_body, in_specs=(sh3, sh1), out_specs=rep,
+    ))(x, n_valid)
+    # drop empty ranks' slots — their contribution is all padding zeros,
+    # which would otherwise train centroids onto the origin (n_valid is
+    # host-known, so the filter is a static replicated gather)
+    xt = jax.jit(
+        lambda a: a[keep].reshape(keep.size * t_per, d)
+    )(sub)
+
+    coarse = kmeans_fit(
+        xt,
+        KMeansParams(
+            n_clusters=nl,
+            max_iter=params.kmeans_n_iters,
+            seed=params.seed,
+            init=params.kmeans_init,
+            # quantizer training tolerates bf16-rounded centroid updates
+            # (intra-cluster averaging washes out operand rounding)
+            compute_dtype="bfloat16",
+        ),
+    )
+    codebooks = _train_pq_codebooks(xt, coarse, params, ds, n_codes)
     cents = coarse.centroids
 
-    # ---- cap swollen lists (always on for the sharded build: the padded
-    # grouped compute AND the LPT balance both degrade with one long list)
+    # ---- phase 2: per-rank blocked encode + global list sizes
+    B = max(1, min(nloc, params.encode_block))
+    nb = _cdiv_host(nloc, B)
+
+    def enc_body(x_sh, nv_sh, cents_in, cbs_in):
+        xb, nvr = x_sh[0], nv_sh[0]
+        xp = jnp.pad(xb, ((0, nb * B - nloc), (0, 0)))
+        lbl, codes = lax.map(
+            lambda blk: _encode_rows(blk, cents_in, cbs_in, M, ds),
+            xp.reshape(nb, B, d),
+        )
+        lbl = lbl.reshape(-1)[:nloc]
+        codes = codes.reshape(-1, M)[:nloc]
+        valid = jnp.arange(nloc, dtype=jnp.int32) < nvr
+        cnt = jnp.zeros((nl + 1,), jnp.int32).at[
+            jnp.where(valid, lbl, nl)
+        ].add(1)[:nl]
+        return lbl[None], codes[None], ax.allgather(cnt)
+
+    lbl_g, codes_g, C = jax.jit(comms.shard_map(
+        enc_body, in_specs=(sh3, sh1, rep, rep),
+        out_specs=(sh2, sh3, rep),
+    ))(x, n_valid, cents, codebooks)
+    C_np = np.asarray(C).astype(np.int64)                    # (P, nl) small
+
+    # ---- phase 3 (host, O(n_lists)): cap split bookkeeping + LPT maps
+    sizes = C_np.sum(0)
     cap = (
         params.max_list_cap
         if params.max_list_cap is not None
-        else max(256, 2 * _cdiv_host(n, params.n_lists))
+        else max(256, 2 * _cdiv_host(n, nl))
     )
-    if cap:
-        labels_np, cents = _split_oversized_lists(labels_np, cents, cap)
-    nl_g = cents.shape[0]
-    sizes = np.bincount(labels_np, minlength=nl_g)
-
-    # ---- list → rank assignment (LPT) + per-rank shard assembly
-    owner, local_id, rows_per, lists_per = _lpt_assign(sizes, n_ranks)
-    n_pad = max(int(rows_per.max()), 1)
-    nl_pad = int(lists_per.max()) + 1          # +1 empty sentinel list
-    max_list = max(int(sizes.max()), 1)
-
-    row_owner = owner[labels_np]
-    codes_sh = np.zeros((n_ranks, n_pad + 1, M), np.uint8)
-    vecs_sh = (
-        np.zeros((n_ranks, n_pad + 1, d), x.dtype)
-        if params.store_raw else None
-    )
-    sids_sh = np.zeros((n_ranks, n_pad), np.int32)
-    offs_sh = np.zeros((n_ranks, nl_pad + 1), np.int32)
-    szs_sh = np.zeros((n_ranks, nl_pad), np.int32)
-    lcents_sh = np.zeros((n_ranks, nl_pad, d), np.float32)
     cents_np = np.asarray(cents, np.float32)
+    if cap:
+        extra = np.maximum(0, -(-sizes // cap) - 1)
+        cum = np.concatenate([[0], np.cumsum(extra)])
+        base_np = (nl + cum[:nl]).astype(np.int32)
+        reps = np.repeat(np.arange(nl), extra)
+        jidx = np.arange(int(extra.sum())) - cum[reps] + 1
+        ssz = np.concatenate([
+            np.minimum(sizes, cap),
+            np.clip(sizes[reps] - jidx * cap, 0, cap),
+        ])
+        cents_np = np.concatenate([cents_np, cents_np[reps]])
+    else:
+        base_np = np.zeros(nl, np.int32)
+        ssz = sizes
+    nl_g = ssz.shape[0]
 
-    for r in range(n_ranks):
-        rows = np.nonzero(row_owner == r)[0].astype(np.int32)
-        lloc = local_id[labels_np[rows]]
-        order = np.argsort(lloc, kind="stable")
-        rows_sorted = rows[order]
-        n_r = rows_sorted.shape[0]
-        sz = np.bincount(lloc, minlength=nl_pad)[:nl_pad]
-        offs_sh[r] = np.concatenate([[0], np.cumsum(sz)]).astype(np.int32)
-        szs_sh[r, :] = sz
-        sids_sh[r, :n_r] = rows_sorted
-        codes_sh[r, :n_r] = codes_np[rows_sorted]
-        if vecs_sh is not None:
-            vecs_sh[r, :n_r] = x[rows_sorted]
+    owner, local_id, loads, lists_per = _lpt_assign(ssz, Pn)
+    n_pad = max(int(loads.max()), 1)
+    nl_pad = int(lists_per.max()) + 1          # +1 empty sentinel list
+    max_list = max(int(ssz.max()), 1)
+
+    offs_sh = np.zeros((Pn, nl_pad + 1), np.int32)
+    szs_sh = np.zeros((Pn, nl_pad), np.int32)
+    lcents_sh = np.zeros((Pn, nl_pad, d), np.float32)
+    for r in range(Pn):
         mine = np.nonzero(owner == r)[0]
-        lcents_sh[r, local_id[mine]] = cents_np[mine]
+        lid = local_id[mine]
+        szs_sh[r, lid] = ssz[mine]
+        offs_sh[r] = np.concatenate([[0], np.cumsum(szs_sh[r])])
+        lcents_sh[r, lid] = cents_np[mine]
 
-    # ---- place: slabs shard over the mesh axis, maps/quantizers
-    # replicate (single placement map, shared with deserialization)
+    # ---- phase 4a: device-side routing. Each row's GLOBAL within-list
+    # rank (a per-rank prefix over the phase-2 count matrix + a local
+    # stable sort) yields both its split sublist AND its exact slab
+    # position on the destination rank — so the exchange below needs no
+    # receive-side sort and no global-max-padded buffers.
+    def route_body(lbl_sh, nv_sh, C_in, owner_in, lid_in, base_in,
+                   offs_in):
+        lbl, nvr = lbl_sh[0], nv_sh[0]
+        valid = jnp.arange(nloc, dtype=jnp.int32) < nvr
+        starts = (jnp.cumsum(C_in, axis=0) - C_in)[ax.get_rank()]
+        key = jnp.where(valid, lbl, nl)
+        order = jnp.argsort(key, stable=True)
+        ksort = key[order]
+        lstart = jnp.searchsorted(
+            ksort, jnp.arange(nl, dtype=jnp.int32)
+        ).astype(jnp.int32)
+        wsort = (
+            jnp.arange(nloc, dtype=jnp.int32)
+            - lstart[jnp.minimum(ksort, nl - 1)]
+        )
+        within = jnp.zeros((nloc,), jnp.int32).at[order].set(wsort)
+        gw = starts[lbl] + within          # global rank within parent list
+        if cap:
+            sub = gw // cap
+            nlbl = jnp.where(sub == 0, lbl, base_in[lbl] + sub - 1)
+            wsub = gw % cap                # rank within the split sublist
+        else:
+            nlbl, wsub = lbl, gw
+        lloc = lid_in[nlbl]
+        dest = jnp.where(valid, owner_in[nlbl], Pn)          # Pn = dropped
+        # destination slab position: owner-local list offset + sublist rank
+        pos = offs_in[jnp.minimum(dest, Pn - 1), lloc] + wsub
+        # send-slot index: this row's rank among rows bound for its dest
+        dorder = jnp.argsort(dest, stable=True)
+        dsort = dest[dorder]
+        dstart = jnp.searchsorted(
+            dsort, jnp.arange(Pn, dtype=jnp.int32)
+        ).astype(jnp.int32)
+        wdsort = (
+            jnp.arange(nloc, dtype=jnp.int32)
+            - dstart[jnp.minimum(dsort, Pn - 1)]
+        )
+        wslot = jnp.zeros((nloc,), jnp.int32).at[dorder].set(wdsort)
+        dcnt = jnp.zeros((Pn + 1,), jnp.int32).at[dest].add(1)[:Pn]
+        return dest[None], pos[None], wslot[None], ax.allgather(dcnt)
+
+    dest_g, pos_g, wslot_g, C2 = jax.jit(comms.shard_map(
+        route_body, in_specs=(sh2, sh1, rep, rep, rep, rep, rep),
+        out_specs=(sh2, sh2, sh2, rep),
+    ))(lbl_g, n_valid, C, owner, local_id, base_np, offs_sh)
+    C2_np = np.asarray(C2)                                   # (src, dst)
+    max_send = max(1, int(C2_np.max()))
+
+    # ---- phase 4b: bounded-round all_to_all exchange + positional slab
+    # scatter. Rounds bound the padded per-payload buffer to (P, ms_r) =
+    # ~half a shard of rows — regardless of P (incl. the 1-device shard
+    # program) and of skewed locality concentrating one source's rows on
+    # one owner, where a single global-max-padded exchange would allocate
+    # P x shard and OOM at the DEEP-100M shard shape.
+    ms_r = min(max_send, max(1024, _cdiv_host(max(nloc, 1), 2 * Pn)))
+    n_rounds = _cdiv_host(max_send, ms_r)
+    gb_np = np.concatenate([[0], np.cumsum(n_valid)[:-1]]).astype(np.int32)
+    store_raw = params.store_raw
+
+    def asm_body(x_sh, codes_sh, dest_sh, pos_sh, wslot_sh, gb_sh, C2_in):
+        xb, cds = x_sh[0], codes_sh[0]
+        dst, pos, wslot, gb = (
+            dest_sh[0], pos_sh[0], wslot_sh[0], gb_sh[0]
+        )
+        me = ax.get_rank()
+        gids = gb + jnp.arange(nloc, dtype=jnp.int32)
+
+        def round_t(t, slabs):
+            codes_sl, sids_sl, vecs_sl = slabs
+            w0 = t * ms_r
+            in_r = (wslot >= w0) & (wslot < w0 + ms_r) & (dst < Pn)
+            dsel = jnp.where(in_r, dst, Pn)                  # Pn drops
+            wr = jnp.where(in_r, wslot - w0, 0)
+
+            def ex(payload, dtype):
+                buf = jnp.zeros((Pn, ms_r) + payload.shape[1:], dtype)
+                buf = buf.at[dsel, wr].set(
+                    payload.astype(dtype), mode="drop"
+                )
+                return ax.alltoall(buf)                      # [s] = from s
+
+            rb_codes = ex(cds, jnp.uint8)                    # (P, ms_r, M)
+            rb_gid = ex(gids, jnp.int32)
+            rb_pos = ex(pos, jnp.int32)
+            valid_r = (
+                w0 + jnp.arange(ms_r, dtype=jnp.int32)[None, :]
+                < C2_in[:, me][:, None]
+            )
+            pc = jnp.where(valid_r, rb_pos, n_pad + 1).reshape(-1)
+            ps = jnp.where(valid_r, rb_pos, n_pad).reshape(-1)
+            codes_sl = codes_sl.at[pc].set(
+                rb_codes.reshape(-1, M), mode="drop"
+            )
+            sids_sl = sids_sl.at[ps].set(rb_gid.reshape(-1), mode="drop")
+            if store_raw:
+                rb_vec = ex(xb, xb.dtype)                    # (P, ms_r, d)
+                vecs_sl = vecs_sl.at[pc].set(
+                    rb_vec.reshape(-1, d), mode="drop"
+                )
+            return (codes_sl, sids_sl, vecs_sl)
+
+        slabs0 = (
+            jnp.zeros((n_pad + 1, M), jnp.uint8),
+            jnp.zeros((n_pad,), jnp.int32),
+            jnp.zeros(
+                (n_pad + 1, d) if store_raw else (1, d), xb.dtype
+            ),
+        )
+        codes_out, sids_out, vecs_out = lax.fori_loop(
+            0, n_rounds, round_t, slabs0
+        )
+        outs = [codes_out[None], sids_out[None]]
+        if store_raw:
+            outs.append(vecs_out[None])
+        return tuple(outs)
+
+    out_specs = (sh3, sh2) + ((sh3,) if store_raw else ())
+    res = jax.jit(comms.shard_map(
+        asm_body, in_specs=(sh3, sh3, sh2, sh2, sh2, sh1, rep),
+        out_specs=out_specs,
+    ))(x, codes_g, dest_g, pos_g, wslot_g, gb_np, C2)
+    codes_sorted, sorted_ids = res[0], res[1]
+    vectors_sorted = res[2] if store_raw else None
+
     host = MnmgIVFPQIndex(
         centroids=cents_np,
         codebooks=np.asarray(codebooks),
         owner=owner,
         local_id=local_id,
         local_cents=lcents_sh,
-        codes_sorted=codes_sh,
-        vectors_sorted=vecs_sh,
-        sorted_ids=sids_sh,
+        codes_sorted=codes_sorted,
+        vectors_sorted=vectors_sorted,
+        sorted_ids=sorted_ids,
         list_offsets=offs_sh,
         list_sizes=szs_sh,
         pq_dim=M,

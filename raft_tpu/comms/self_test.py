@@ -25,6 +25,7 @@ __all__ = [
     "test_collective_gather",
     "test_collective_gatherv",
     "test_collective_reducescatter",
+    "test_collective_alltoall",
     "test_pointToPoint_simple_send_recv",
     "test_collective_comm_split",
     "run_all_self_tests",
@@ -138,6 +139,23 @@ def test_pointToPoint_simple_send_recv(comms: Comms) -> bool:
     return bool(np.all(np.asarray(_run(comms, body))))
 
 
+def test_collective_alltoall(comms: Comms) -> bool:
+    """Rank r sends value r*size+j to rank j; slot s must read s*size+me
+    (the MPI_Alltoall contract; backbone of the distributed index build's
+    row exchange, mnmg_ivf.py)."""
+    ax = comms.device_comms()
+    size = comms.size
+
+    def body():
+        me = ax.get_rank().astype(jnp.int32)
+        sent = me * size + jnp.arange(size, dtype=jnp.int32)[:, None]
+        got = ax.alltoall(sent)                              # (size, 1)
+        want = jnp.arange(size, dtype=jnp.int32)[:, None] * size + me
+        return jnp.all(got == want).astype(jnp.int32)
+
+    return bool(np.all(np.asarray(_run(comms, body))))
+
+
 def test_collective_comm_split(comms: Comms) -> bool:
     """Split into even/odd halves; allreduce inside each half
     (reference test_commsplit, test.hpp:477)."""
@@ -163,6 +181,7 @@ def run_all_self_tests(comms: Comms) -> dict:
         "gather": test_collective_gather(comms),
         "gatherv": test_collective_gatherv(comms),
         "reducescatter": test_collective_reducescatter(comms),
+        "alltoall": test_collective_alltoall(comms),
         "sendrecv": test_pointToPoint_simple_send_recv(comms),
         "comm_split": test_collective_comm_split(comms),
     }

@@ -102,24 +102,16 @@ def _cdiv_host(a: int, b: int) -> int:
 
 
 
-def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
-    """Subsample-trained codebooks + streaming full-dataset encode.
-
-    PQ codebooks train on the residuals of the training subsample only;
-    the full dataset is then labeled and coded in ``encode_block``-row
-    blocks by one jitted program (block shape is static, so every block
-    reuses the same executable). Peak transient memory is
-    O(encode_block * d) instead of O(n * d) — the property that lets a
-    16 GB chip build a 10M+ index.
-    """
+def _train_pq_codebooks(xt, coarse, params, ds, n_codes):
+    """PQ codebooks from the TRAINING SUBSAMPLE's residuals only — the
+    shared quantizer-training tail of the blocked single-chip build and
+    the distributed per-rank build (comms/mnmg_ivf.py). ``coarse`` must
+    have been fit on ``xt`` (its labels ARE the subsample assignments —
+    no second (train_n, n_lists, d) pass)."""
     from raft_tpu.cluster.kmeans import kmeans_fit_batched
 
-    n, d = x.shape
     M = params.pq_dim
     train_n = xt.shape[0]
-
-    # coarse.labels ARE the training rows' assignments — no second
-    # (train_n, n_lists, d) pass
     res_t = xt - coarse.centroids[coarse.labels]
     sub_t = res_t.reshape(train_n, M, ds).transpose(1, 0, 2)  # (M, tn, ds)
     outs = kmeans_fit_batched(
@@ -132,15 +124,37 @@ def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
             compute_dtype="bfloat16",
         ),
     )
-    codebooks = outs.centroids                                # (M, K, ds)
+    return outs.centroids                                     # (M, K, ds)
+
+
+def _encode_rows(blk, coarse_centroids, codebooks, M, ds):
+    """Label + PQ-encode one row block against replicated quantizers —
+    the per-block body of the streaming encode, shared with the
+    distributed build's per-rank shard_map encode."""
+    lbl = kmeans_predict(blk, coarse_centroids)
+    res = blk - coarse_centroids[lbl]
+    s = res.reshape(blk.shape[0], M, ds).transpose(1, 0, 2)
+    codes = jax.vmap(kmeans_predict)(s, codebooks).T.astype(jnp.uint8)
+    return lbl.astype(jnp.int32), codes
+
+
+def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
+    """Subsample-trained codebooks + streaming full-dataset encode.
+
+    PQ codebooks train on the residuals of the training subsample only;
+    the full dataset is then labeled and coded in ``encode_block``-row
+    blocks by one jitted program (block shape is static, so every block
+    reuses the same executable). Peak transient memory is
+    O(encode_block * d) instead of O(n * d) — the property that lets a
+    16 GB chip build a 10M+ index.
+    """
+    n, d = x.shape
+    M = params.pq_dim
+    codebooks = _train_pq_codebooks(xt, coarse, params, ds, n_codes)
 
     @jax.jit
     def encode_one(blk):
-        lbl = kmeans_predict(blk, coarse.centroids)
-        res = blk - coarse.centroids[lbl]
-        s = res.reshape(blk.shape[0], M, ds).transpose(1, 0, 2)
-        codes = jax.vmap(kmeans_predict)(s, codebooks).T.astype(jnp.uint8)
-        return lbl.astype(jnp.int32), codes
+        return _encode_rows(blk, coarse.centroids, codebooks, M, ds)
 
     B = params.encode_block
     lbl_parts, code_parts = [], []

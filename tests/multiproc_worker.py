@@ -69,14 +69,38 @@ def main() -> None:
     from raft_tpu.comms import mnmg_ivf_pq_build, mnmg_ivf_pq_search
     from raft_tpu.spatial.ann import IVFPQParams
 
-    idx = mnmg_ivf_pq_build(comms, x, IVFPQParams(
+    ivf_params = IVFPQParams(
         n_lists=8, pq_dim=4, pq_bits=6, kmeans_n_iters=4, seed=0,
-    ))
+    )
+    idx = mnmg_ivf_pq_build(comms, x, ivf_params)
     dq, iq = mnmg_ivf_pq_search(
         comms, idx, x[:16], 3, n_probes=8, refine_ratio=4.0, qcap=16,
     )
     iq_np = np.asarray(iq)
     ivf_self = bool((iq_np[:, 0] == np.arange(16)).all())
+
+    # the per-rank build path under REAL process boundaries: each process
+    # device_puts ONLY the row shards of its own devices (the true
+    # distributed data model — no process ever assembles the full
+    # dataset), and the resulting index must search identically to the
+    # one-host wrapper build above (same pipeline, same global ids)
+    from raft_tpu.comms.mnmg_ivf import (
+        mnmg_ivf_pq_build_distributed, shard_rows,
+    )
+
+    # shard_rows device_puts ONLY this process's devices' shards — each
+    # process transfers its local rows and nothing else crosses the host
+    xg, n_valid = shard_rows(comms, x)
+    idx2 = mnmg_ivf_pq_build_distributed(
+        comms, xg, ivf_params, n_valid=n_valid
+    )
+    dq2, iq2 = mnmg_ivf_pq_search(
+        comms, idx2, x[:16], 3, n_probes=8, refine_ratio=4.0, qcap=16,
+    )
+    dist_matches_wrapper = bool(
+        (np.asarray(iq2) == iq_np).all()
+        and np.allclose(np.asarray(dq2), np.asarray(dq), rtol=1e-5)
+    )
 
     print(json.dumps({
         "rank": rank,
@@ -88,6 +112,7 @@ def main() -> None:
         "centroid_sum": float(np.asarray(out.centroids, np.float64).sum()),
         "ivf_self_recall": ivf_self,
         "ivf_ids_sum": int(iq_np.sum()),
+        "ivf_dist_build_matches": dist_matches_wrapper,
     }), flush=True)
 
 

@@ -94,3 +94,11 @@ def test_mnmg_ivf_pq_across_processes(worker_reports):
         assert r["ivf_self_recall"] is True, r
     id_sums = {r["ivf_ids_sum"] for r in worker_reports}
     assert len(id_sums) == 1, id_sums
+
+
+def test_distributed_build_per_rank_rows_across_processes(worker_reports):
+    """Each process feeds ONLY its own devices' row shards to
+    mnmg_ivf_pq_build_distributed; the index must search identically to
+    the one-host wrapper build (VERDICT r4 item 1 'done' criterion)."""
+    for r in worker_reports:
+        assert r["ivf_dist_build_matches"] is True, r
