@@ -23,8 +23,16 @@ from raft_tpu.comms.mnmg import mnmg_knn, mnmg_kmeans_fit
 from raft_tpu.comms.mnmg_ivf import (
     MnmgIVFPQIndex,
     mnmg_ivf_pq_build,
+    mnmg_ivf_pq_build_distributed,
     mnmg_ivf_pq_search,
     place_index,
+    shard_rows,
+)
+from raft_tpu.comms.mnmg_ivf_flat import (
+    MnmgIVFFlatIndex,
+    mnmg_ivf_flat_build,
+    mnmg_ivf_flat_build_distributed,
+    mnmg_ivf_flat_search,
 )
 from raft_tpu.comms.ring import ring_knn, ring_pairwise_distance
 
@@ -43,8 +51,14 @@ __all__ = [
     "mnmg_kmeans_fit",
     "MnmgIVFPQIndex",
     "mnmg_ivf_pq_build",
+    "mnmg_ivf_pq_build_distributed",
     "mnmg_ivf_pq_search",
+    "MnmgIVFFlatIndex",
+    "mnmg_ivf_flat_build",
+    "mnmg_ivf_flat_build_distributed",
+    "mnmg_ivf_flat_search",
     "place_index",
+    "shard_rows",
     "ring_knn",
     "ring_pairwise_distance",
 ]

@@ -255,51 +255,9 @@ def mnmg_ivf_pq_build_distributed(
     rep = P()
 
     # ---- phase 1: collective training subsample -> replicated quantizers
-    train_n = min(
-        n,
-        params.train_size
-        if params.train_size is not None
-        else max(1 << 20, 64 * nl),
-    )
-    # quota per NON-EMPTY rank: empty shards are filtered from the gather
-    # below, so splitting the budget across all P ranks would shrink the
-    # training set below train_n (and below the n_lists/2^pq_bits minima
-    # the global-n guards above already validated)
-    keep = np.nonzero(n_valid > 0)[0]
-    t_per = _cdiv_host(train_n, max(keep.size, 1))
-    key0 = jax.random.PRNGKey(params.seed)
-
-    def sub_body(x_sh, nv_sh):
-        xb, nvr = x_sh[0], nv_sh[0]
-        key = jax.random.fold_in(key0, ax.get_rank())
-        # a random permutation prefix: exact without-replacement sampling
-        # on full shards (t_per == n_loc covers every row); ragged shards
-        # remap the out-of-range picks with a modulo (mild duplication)
-        sel = jax.random.permutation(key, nloc)[:t_per]
-        sel = jnp.where(sel < nvr, sel, sel % jnp.maximum(nvr, 1))
-        return ax.allgather(jnp.take(xb, sel, axis=0))       # (P, t_per, d)
-
-    sub = jax.jit(comms.shard_map(
-        sub_body, in_specs=(sh3, sh1), out_specs=rep,
-    ))(x, n_valid)
-    # drop empty ranks' slots — their contribution is all padding zeros,
-    # which would otherwise train centroids onto the origin (n_valid is
-    # host-known, so the filter is a static replicated gather)
-    xt = jax.jit(
-        lambda a: a[keep].reshape(keep.size * t_per, d)
-    )(sub)
-
-    coarse = kmeans_fit(
-        xt,
-        KMeansParams(
-            n_clusters=nl,
-            max_iter=params.kmeans_n_iters,
-            seed=params.seed,
-            init=params.kmeans_init,
-            # quantizer training tolerates bf16-rounded centroid updates
-            # (intra-cluster averaging washes out operand rounding)
-            compute_dtype="bfloat16",
-        ),
+    xt, coarse = _train_coarse_distributed(
+        comms, x, n_valid, n, nl, params.train_size,
+        params.kmeans_n_iters, params.kmeans_init, params.seed,
     )
     codebooks = _train_pq_codebooks(xt, coarse, params, ds, n_codes)
     cents = coarse.centroids
@@ -327,15 +285,129 @@ def mnmg_ivf_pq_build_distributed(
         enc_body, in_specs=(sh3, sh1, rep, rep),
         out_specs=(sh2, sh3, rep),
     ))(x, n_valid, cents, codebooks)
-    C_np = np.asarray(C).astype(np.int64)                    # (P, nl) small
 
-    # ---- phase 3 (host, O(n_lists)): cap split bookkeeping + LPT maps
-    sizes = C_np.sum(0)
     cap = (
         params.max_list_cap
         if params.max_list_cap is not None
         else max(256, 2 * _cdiv_host(n, nl))
     )
+    maps, slabs = _exchange_and_assemble(
+        comms, x, n_valid, lbl_g, C, cents, cap,
+        store_vectors=params.store_raw, codes_g=codes_g, M=M,
+    )
+
+    host = MnmgIVFPQIndex(
+        centroids=maps["cents_np"],
+        codebooks=np.asarray(codebooks),
+        owner=maps["owner"],
+        local_id=maps["local_id"],
+        local_cents=maps["lcents_sh"],
+        codes_sorted=slabs["codes"],
+        vectors_sorted=slabs.get("vecs"),
+        sorted_ids=slabs["sids"],
+        list_offsets=maps["offs_sh"],
+        list_sizes=maps["szs_sh"],
+        pq_dim=M,
+        pq_bits=params.pq_bits,
+        n_pad=maps["n_pad"],
+        nl_pad=maps["nl_pad"],
+        max_list=maps["max_list"],
+        n_rows=n,
+    )
+    return place_index(comms, host)
+
+
+def _train_coarse_distributed(
+    comms: Comms, x, n_valid, n: int, nl: int, train_size,
+    kmeans_n_iters: int, kmeans_init: str, seed: int,
+):
+    """Phase 1 of every distributed list-sharded build (PQ and Flat):
+    collective training subsample + replicated coarse k-means.
+
+    Every NON-EMPTY rank contributes ``train_n / n_active`` uniformly
+    sampled local rows to one ``all_gather`` (empty shards are filtered
+    host-side — their slots would be all padding zeros and train
+    centroids onto the origin; the per-active-rank quota keeps the
+    training set at ``train_n`` so the caller's global-n minima hold).
+    A random-permutation prefix gives exact without-replacement sampling
+    on full shards; ragged shards remap out-of-range picks with a modulo
+    (mild duplication). Returns (xt, coarse KMeansOutput)."""
+    Pn, nloc, d = x.shape
+    n_valid = np.asarray(n_valid, np.int32)
+    ax = comms.device_comms()
+    sh3 = _P3(comms.axis)
+    sh1 = P(comms.axis)
+    rep = P()
+    train_n = min(
+        n,
+        train_size if train_size is not None else max(1 << 20, 64 * nl),
+    )
+    keep = np.nonzero(n_valid > 0)[0]
+    t_per = _cdiv_host(train_n, max(keep.size, 1))
+    key0 = jax.random.PRNGKey(seed)
+
+    def sub_body(x_sh, nv_sh):
+        xb, nvr = x_sh[0], nv_sh[0]
+        key = jax.random.fold_in(key0, ax.get_rank())
+        sel = jax.random.permutation(key, nloc)[:t_per]
+        sel = jnp.where(sel < nvr, sel, sel % jnp.maximum(nvr, 1))
+        return ax.allgather(jnp.take(xb, sel, axis=0))       # (P, t_per, d)
+
+    sub = jax.jit(comms.shard_map(
+        sub_body, in_specs=(sh3, sh1), out_specs=rep,
+    ))(x, n_valid)
+    xt = jax.jit(
+        lambda a: a[keep].reshape(keep.size * t_per, d)
+    )(sub)
+
+    coarse = kmeans_fit(
+        xt,
+        KMeansParams(
+            n_clusters=nl,
+            max_iter=kmeans_n_iters,
+            seed=seed,
+            init=kmeans_init,
+            # quantizer training tolerates bf16-rounded centroid updates
+            # (intra-cluster averaging washes out operand rounding)
+            compute_dtype="bfloat16",
+        ),
+    )
+    return xt, coarse
+
+
+def _exchange_and_assemble(
+    comms: Comms, x, n_valid, lbl_g, C, cents, cap: int,
+    store_vectors: bool, codes_g=None, M: int = 0,
+):
+    """Phases 3-4 of every distributed list-sharded build (PQ and Flat):
+
+    * host-side O(n_lists) bookkeeping — oversized-list split sizes,
+      greedy-LPT ``owner``/``local_id``, per-rank offset/size/centroid
+      slabs;
+    * device-side routing — each row's GLOBAL within-list rank (per-rank
+      prefix over the gathered count matrix ``C`` + one local stable
+      sort) yields its split sublist AND its exact destination slab
+      position;
+    * bounded-round ``all_to_all`` exchange (buffers ~half a shard of
+      padded rows per payload) with positional receive-side scatter.
+
+    ``codes_g`` (P, n_loc, M) adds the PQ code payload; ``store_vectors``
+    adds the raw-row payload. Returns (maps, slabs): host metadata
+    arrays + the device-sharded ``sids`` / ``codes`` / ``vecs`` slabs.
+    """
+    Pn, nloc, d = x.shape
+    nl = C.shape[1]
+    n_valid = np.asarray(n_valid, np.int32)
+    n = int(n_valid.sum())
+    ax = comms.device_comms()
+    sh3 = _P3(comms.axis)
+    sh2 = P(comms.axis, None)
+    sh1 = P(comms.axis)
+    rep = P()
+
+    # ---- phase 3 (host, O(n_lists)): cap split bookkeeping + LPT maps
+    C_np = np.asarray(C).astype(np.int64)                    # (P, nl) small
+    sizes = C_np.sum(0)
     cents_np = np.asarray(cents, np.float32)
     if cap:
         extra = np.maximum(0, -(-sizes // cap) - 1)
@@ -351,7 +423,6 @@ def mnmg_ivf_pq_build_distributed(
     else:
         base_np = np.zeros(nl, np.int32)
         ssz = sizes
-    nl_g = ssz.shape[0]
 
     owner, local_id, loads, lists_per = _lpt_assign(ssz, Pn)
     n_pad = max(int(loads.max()), 1)
@@ -430,7 +501,11 @@ def mnmg_ivf_pq_build_distributed(
     ms_r = min(max_send, max(1024, _cdiv_host(max(nloc, 1), 2 * Pn)))
     n_rounds = _cdiv_host(max_send, ms_r)
     gb_np = np.concatenate([[0], np.cumsum(n_valid)[:-1]]).astype(np.int32)
-    store_raw = params.store_raw
+    with_codes = codes_g is not None
+    codes_in = (
+        codes_g if with_codes
+        else jnp.zeros((Pn, 1, 1), jnp.uint8)   # unused placeholder
+    )
 
     def asm_body(x_sh, codes_sh, dest_sh, pos_sh, wslot_sh, gb_sh, C2_in):
         xb, cds = x_sh[0], codes_sh[0]
@@ -454,7 +529,6 @@ def mnmg_ivf_pq_build_distributed(
                 )
                 return ax.alltoall(buf)                      # [s] = from s
 
-            rb_codes = ex(cds, jnp.uint8)                    # (P, ms_r, M)
             rb_gid = ex(gids, jnp.int32)
             rb_pos = ex(pos, jnp.int32)
             valid_r = (
@@ -463,11 +537,13 @@ def mnmg_ivf_pq_build_distributed(
             )
             pc = jnp.where(valid_r, rb_pos, n_pad + 1).reshape(-1)
             ps = jnp.where(valid_r, rb_pos, n_pad).reshape(-1)
-            codes_sl = codes_sl.at[pc].set(
-                rb_codes.reshape(-1, M), mode="drop"
-            )
+            if with_codes:
+                rb_codes = ex(cds, jnp.uint8)                # (P, ms_r, M)
+                codes_sl = codes_sl.at[pc].set(
+                    rb_codes.reshape(-1, M), mode="drop"
+                )
             sids_sl = sids_sl.at[ps].set(rb_gid.reshape(-1), mode="drop")
-            if store_raw:
+            if store_vectors:
                 rb_vec = ex(xb, xb.dtype)                    # (P, ms_r, d)
                 vecs_sl = vecs_sl.at[pc].set(
                     rb_vec.reshape(-1, d), mode="drop"
@@ -475,50 +551,54 @@ def mnmg_ivf_pq_build_distributed(
             return (codes_sl, sids_sl, vecs_sl)
 
         slabs0 = (
-            jnp.zeros((n_pad + 1, M), jnp.uint8),
+            jnp.zeros((n_pad + 1, M) if with_codes else (1, 1), jnp.uint8),
             jnp.zeros((n_pad,), jnp.int32),
             jnp.zeros(
-                (n_pad + 1, d) if store_raw else (1, d), xb.dtype
+                (n_pad + 1, d) if store_vectors else (1, d), xb.dtype
             ),
         )
         codes_out, sids_out, vecs_out = lax.fori_loop(
             0, n_rounds, round_t, slabs0
         )
-        outs = [codes_out[None], sids_out[None]]
-        if store_raw:
+        outs = [sids_out[None]]
+        if with_codes:
+            outs.append(codes_out[None])
+        if store_vectors:
             outs.append(vecs_out[None])
         return tuple(outs)
 
-    out_specs = (sh3, sh2) + ((sh3,) if store_raw else ())
+    out_specs = (
+        (sh2,) + ((sh3,) if with_codes else ())
+        + ((sh3,) if store_vectors else ())
+    )
     res = jax.jit(comms.shard_map(
         asm_body, in_specs=(sh3, sh3, sh2, sh2, sh2, sh1, rep),
         out_specs=out_specs,
-    ))(x, codes_g, dest_g, pos_g, wslot_g, gb_np, C2)
-    codes_sorted, sorted_ids = res[0], res[1]
-    vectors_sorted = res[2] if store_raw else None
+    ))(x, codes_in, dest_g, pos_g, wslot_g, gb_np, C2)
+    slabs = {"sids": res[0]}
+    i = 1
+    if with_codes:
+        slabs["codes"] = res[i]
+        i += 1
+    if store_vectors:
+        slabs["vecs"] = res[i]
 
-    host = MnmgIVFPQIndex(
-        centroids=cents_np,
-        codebooks=np.asarray(codebooks),
-        owner=owner,
-        local_id=local_id,
-        local_cents=lcents_sh,
-        codes_sorted=codes_sorted,
-        vectors_sorted=vectors_sorted,
-        sorted_ids=sorted_ids,
-        list_offsets=offs_sh,
-        list_sizes=szs_sh,
-        pq_dim=M,
-        pq_bits=params.pq_bits,
-        n_pad=n_pad,
-        nl_pad=nl_pad,
-        max_list=max_list,
-        n_rows=n,
-    )
-    return place_index(comms, host)
+    maps = {
+        "cents_np": cents_np,
+        "owner": owner,
+        "local_id": local_id,
+        "lcents_sh": lcents_sh,
+        "offs_sh": offs_sh,
+        "szs_sh": szs_sh,
+        "n_pad": n_pad,
+        "nl_pad": nl_pad,
+        "max_list": max_list,
+    }
+    return maps, slabs
 
 
 # fields whose leading axis is the mesh axis; everything else replicates
+# (shared by every sharded index type — PQ and Flat)
 _SHARDED_FIELDS = frozenset({
     "local_cents", "codes_sorted", "vectors_sorted", "sorted_ids",
     "list_offsets", "list_sizes",
@@ -526,8 +606,8 @@ _SHARDED_FIELDS = frozenset({
 
 
 def field_sharding(comms: Comms, name: str, ndim: int):
-    """The NamedSharding :func:`mnmg_ivf_pq_build` gives each index field
-    (the single source of the field→sharding map; serialization streams
+    """The NamedSharding the sharded builds give each index field (the
+    single source of the field→sharding map; serialization streams
     loaded slabs straight to it)."""
     if name in _SHARDED_FIELDS:
         return NamedSharding(
@@ -536,27 +616,28 @@ def field_sharding(comms: Comms, name: str, ndim: int):
     return NamedSharding(comms.mesh, P())
 
 
-def place_index(comms: Comms, index: MnmgIVFPQIndex) -> MnmgIVFPQIndex:
+def place_index(comms: Comms, index):
     """(Re-)place a sharded index's arrays onto a comms mesh: slabs shard
-    over the mesh axis, quantizers and ownership maps replicate. Used by
-    :func:`mnmg_ivf_pq_build` itself and after
+    over the mesh axis, quantizers and ownership maps replicate. Works on
+    any sharded index dataclass (MnmgIVFPQIndex, MnmgIVFFlatIndex); used
+    by the builds themselves and after
     :func:`raft_tpu.spatial.ann.load_index`. The index must have been
     built for the same mesh size (its slab leading axis)."""
-    n_ranks = index.codes_sorted.shape[0]
+    n_ranks = index.sorted_ids.shape[0]
     errors.expects(
         n_ranks == comms.size,
         "place_index: index built for %d ranks, mesh has %d",
         n_ranks, comms.size,
     )
     kw = {}
-    for f in dataclasses.fields(MnmgIVFPQIndex):
+    for f in dataclasses.fields(type(index)):
         v = getattr(index, f.name)
         if v is not None and f.metadata.get("static") is None:
             v = jax.device_put(
                 v, field_sharding(comms, f.name, np.ndim(v))
             )
         kw[f.name] = v
-    return MnmgIVFPQIndex(**kw)
+    return type(index)(**kw)
 
 
 @functools.lru_cache(maxsize=32)
@@ -642,6 +723,7 @@ def mnmg_ivf_pq_search(
     list_block: int = 8,
     refine_ratio: float = 2.0, exact_selection: bool = True,
     approx_recall_target: float = 0.95,
+    qcap_max_drop_frac: typing.Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed grouped ADC search over a list-sharded index.
 
@@ -681,7 +763,10 @@ def mnmg_ivf_pq_search(
         "approx_recall_target=%s out of range (0, 1]", approx_recall_target,
     )
     nl_g = index.centroids.shape[0]
-    qcap, _ = resolve_qcap_arg(qcap, q, index.centroids, nl_g, n_probes)
+    qcap, _ = resolve_qcap_arg(
+        qcap, q, index.centroids, nl_g, n_probes,
+        max_drop_frac=qcap_max_drop_frac,
+    )
     list_block = max(1, min(list_block, index.nl_pad))
     store_raw = index.vectors_sorted is not None
     statics = (

@@ -1,0 +1,331 @@
+"""Multi-chip sharded IVF-Flat — exact (uncompressed) scoring at list
+granularity over a device mesh.
+
+The 10-60M-row regime is where this engine is THE answer: raw vectors
+fit the 8-chip aggregate HBM but not one chip, and at those (n, d) the
+measured crossover data (docs/ivf_scale.md "High-d crossover") says
+dense/exact scoring beats ADC per probed row — so a list-sharded
+recall-1.0 IVF beats both a single-chip PQ index (compression it does
+not need) and replicated dense scans (P x the work). The reference
+carries this capability through the Flat branch of its FAISS dispatch
+(cpp/include/raft/spatial/knn/detail/ann_quantized_faiss.cuh:115-142,
+``IVFFlatParam``); here it is the same mesh program as the sharded PQ
+index (comms/mnmg_ivf.py) with exact scoring in place of ADC:
+
+* **Shard lists, replicate the coarse quantizer** — greedy-LPT list
+  ownership, each chip holding its lists' raw rows contiguously
+  (``vectors_sorted``) with GLOBAL ids.
+* **Queries replicate; rows never move.** Every chip probes the global
+  centroids, keeps its owned probes (sentinel list otherwise), and runs
+  the UNCHANGED single-chip grouped exact kernel
+  (:func:`raft_tpu.spatial.ann.ivf_flat._grouped_impl`) on its shard.
+* **Merge is a k-way top-k** over one (nq, k) allgather pair.
+
+The build reuses the whole distributed pipeline — collective subsample
+training, per-rank blocked assignment, bounded-round ``all_to_all`` row
+exchange with positional slab scatter — via
+:func:`raft_tpu.comms.mnmg_ivf._exchange_and_assemble`; no host ever
+holds more than its own row shard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import typing
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu import errors
+from raft_tpu.cluster.kmeans import kmeans_predict
+from raft_tpu.comms.comms import Comms
+from raft_tpu.comms.mnmg_ivf import (
+    _cdiv_host,
+    _exchange_and_assemble,
+    _P3,
+    _train_coarse_distributed,
+    place_index,
+    shard_rows,
+)
+from raft_tpu.spatial.ann.common import (
+    ListStorage,
+    coarse_probe,
+    resolve_qcap_arg,
+)
+from raft_tpu.spatial.ann.ivf_flat import (
+    IVFFlatIndex,
+    IVFFlatParams,
+    _grouped_impl,
+)
+from raft_tpu.spatial.selection import select_k
+
+__all__ = [
+    "MnmgIVFFlatIndex", "mnmg_ivf_flat_build",
+    "mnmg_ivf_flat_build_distributed", "mnmg_ivf_flat_search",
+]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MnmgIVFFlatIndex:
+    """List-sharded IVF-Flat index over a comms mesh (the exact-scoring
+    sibling of :class:`raft_tpu.comms.mnmg_ivf.MnmgIVFPQIndex`; field
+    names shared with it so placement/serialization machinery applies
+    unchanged)."""
+
+    centroids: jax.Array       # (n_lists_g, d) replicated
+    owner: jax.Array           # (n_lists_g,) int32 — owning rank per list
+    local_id: jax.Array        # (n_lists_g,) int32 — list id on its owner
+    local_cents: jax.Array     # (P, nl_pad, d) — per-chip centroid slab
+    vectors_sorted: jax.Array  # (P, n_pad + 1, d) raw rows, list-sorted
+    sorted_ids: jax.Array      # (P, n_pad) int32 GLOBAL row ids
+    list_offsets: jax.Array    # (P, nl_pad + 1) int32
+    list_sizes: jax.Array      # (P, nl_pad) int32
+    n_pad: int = dataclasses.field(metadata=dict(static=True))
+    nl_pad: int = dataclasses.field(metadata=dict(static=True))
+    max_list: int = dataclasses.field(metadata=dict(static=True))
+    n_rows: int = dataclasses.field(metadata=dict(static=True))
+    metric: str = dataclasses.field(metadata=dict(static=True))
+
+
+def mnmg_ivf_flat_build(
+    comms: Comms, x, params: IVFFlatParams = IVFFlatParams(), *,
+    metric: str = "l2",
+) -> MnmgIVFFlatIndex:
+    """One-host convenience wrapper: row-shard ``x`` onto the mesh (one
+    shard transient at a time, :func:`shard_rows`) and run the per-rank
+    distributed build."""
+    x = np.asarray(x)
+    errors.expects(
+        x.ndim == 2 and x.shape[0] >= 2,
+        "x: expected a (n >= 2, d) matrix, got shape %s", tuple(x.shape),
+    )
+    xg, n_valid = shard_rows(comms, x)
+    return mnmg_ivf_flat_build_distributed(
+        comms, xg, params, n_valid=n_valid, metric=metric
+    )
+
+
+def mnmg_ivf_flat_build_distributed(
+    comms: Comms, x, params: IVFFlatParams = IVFFlatParams(), *,
+    n_valid=None, metric: str = "l2",
+) -> MnmgIVFFlatIndex:
+    """Build a list-sharded IVF-Flat index from PER-RANK row shards — the
+    Flat sibling of
+    :func:`raft_tpu.comms.mnmg_ivf.mnmg_ivf_pq_build_distributed` (same
+    input convention: ``x`` (P, n_loc, d) sharded ``P(axis, None, None)``,
+    ``n_valid`` (P,) valid rows per rank, global ids by contiguous block).
+
+    Pipeline: collective subsample -> replicated coarse k-means ->
+    per-rank blocked assignment -> shared distributed list assembly
+    (:func:`_exchange_and_assemble`: oversized-list split on GLOBAL
+    within-list ranks, greedy-LPT ownership, bounded-round ``all_to_all``
+    row exchange, positional slab scatter). Raw rows always co-shard with
+    their lists — exact scoring needs them.
+
+    ``max_list_cap``: ``None`` here means AUTO (``max(256, 2 * n /
+    n_lists)``) — the sharded grouped compute and the LPT balance both
+    degrade with one swollen list; pass ``0`` to disable.
+    """
+    errors.expects(
+        hasattr(x, "ndim") and x.ndim == 3,
+        "x: expected (n_ranks, n_loc, d) stacked row shards, got %s",
+        tuple(getattr(x, "shape", ())),
+    )
+    Pn, nloc, d = x.shape
+    errors.expects(
+        Pn == comms.size,
+        "x leading axis %d != mesh size %d", Pn, comms.size,
+    )
+    errors.expects(
+        metric in ("l2", "sqeuclidean"),
+        "metric %r not supported (l2 | sqeuclidean)", metric,
+    )
+    if n_valid is None:
+        n_valid = np.full(Pn, nloc, np.int32)
+    n_valid = np.asarray(n_valid, np.int32)
+    n = int(n_valid.sum())
+    errors.check_k(params.n_lists, n, "n_lists vs dataset rows")
+    nl = params.n_lists
+    ax = comms.device_comms()
+    sh3 = _P3(comms.axis)
+    sh1 = P(comms.axis)
+    sh2 = P(comms.axis, None)
+    rep = P()
+
+    # ---- phase 1: collective training subsample -> replicated coarse
+    # quantizer (shared helper with the PQ build; quantizer quality
+    # saturates far below shard size)
+    _, coarse = _train_coarse_distributed(
+        comms, x, n_valid, n, nl, None,
+        params.kmeans_n_iters, params.kmeans_init, params.seed,
+    )
+    cents = coarse.centroids
+
+    # ---- phase 2: per-rank blocked assignment + global list sizes
+    B = max(1, min(nloc, 1 << 20))
+    nb = _cdiv_host(nloc, B)
+
+    def asg_body(x_sh, nv_sh, cents_in):
+        xb, nvr = x_sh[0], nv_sh[0]
+        xp = jnp.pad(xb, ((0, nb * B - nloc), (0, 0)))
+        lbl = lax.map(
+            lambda blk: kmeans_predict(blk, cents_in).astype(jnp.int32),
+            xp.reshape(nb, B, d),
+        ).reshape(-1)[:nloc]
+        valid = jnp.arange(nloc, dtype=jnp.int32) < nvr
+        cnt = jnp.zeros((nl + 1,), jnp.int32).at[
+            jnp.where(valid, lbl, nl)
+        ].add(1)[:nl]
+        return lbl[None], ax.allgather(cnt)
+
+    lbl_g, C = jax.jit(comms.shard_map(
+        asg_body, in_specs=(sh3, sh1, rep), out_specs=(sh2, rep),
+    ))(x, n_valid, cents)
+
+    cap = (
+        params.max_list_cap
+        if params.max_list_cap is not None
+        else max(256, 2 * _cdiv_host(n, nl))
+    )
+    maps, slabs = _exchange_and_assemble(
+        comms, x, n_valid, lbl_g, C, cents, cap,
+        store_vectors=True,
+    )
+
+    host = MnmgIVFFlatIndex(
+        centroids=maps["cents_np"],
+        owner=maps["owner"],
+        local_id=maps["local_id"],
+        local_cents=maps["lcents_sh"],
+        vectors_sorted=slabs["vecs"],
+        sorted_ids=slabs["sids"],
+        list_offsets=maps["offs_sh"],
+        list_sizes=maps["szs_sh"],
+        n_pad=maps["n_pad"],
+        nl_pad=maps["nl_pad"],
+        max_list=maps["max_list"],
+        n_rows=n,
+        metric=metric,
+    )
+    return place_index(comms, host)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_search(
+    mesh: jax.sharding.Mesh, axis: str, statics: tuple
+):
+    """Compile one shard_map search program per (mesh, static-config);
+    keyed on value-hashable (mesh, axis), not the Comms identity."""
+    (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list) = statics
+    comms = Comms(mesh=mesh, axis=axis)
+    ax = comms.device_comms()
+
+    def body(cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs, q):
+        lcents, vecs, sids = lcents[0], vecs_s[0], sids[0]
+        loffs, lszs = loffs[0], lszs[0]
+        rank = lax.axis_index(ax.axis)
+
+        qf = q.astype(jnp.float32)
+        # replicated compute: identical global probes on every chip
+        probes_g, _ = coarse_probe(qf, cents, n_probes)      # (nq, p)
+        own = owner[probes_g] == rank
+        lp = jnp.where(
+            own, local_id[probes_g], jnp.int32(nl_pad - 1)   # sentinel list
+        )
+
+        storage = ListStorage(
+            sorted_ids=sids,
+            list_offsets=loffs,
+            list_index=jnp.zeros((nl_pad, 1), jnp.int32),    # grouped unused
+            list_sizes=lszs,
+            n=n_pad,
+            max_list=max_list,
+        )
+        shard = IVFFlatIndex(
+            centroids=lcents, data_sorted=vecs, storage=storage,
+            metric="sqeuclidean",  # sqrt applied after the merge
+        )
+        # the UNCHANGED single-chip grouped exact kernel, probes
+        # pre-mapped to shard-local list ids; sorted_ids are global
+        vals, gids = _grouped_impl(
+            shard, qf, k, n_probes, qcap, list_block, probes=lp,
+        )
+        pd = ax.allgather(vals)                              # (P, nq, k)
+        pi = ax.allgather(gids)
+        nq = q.shape[0]
+        flat_d = pd.transpose(1, 0, 2).reshape(nq, -1)
+        flat_i = pi.transpose(1, 0, 2).reshape(nq, -1)
+        md, mi = select_k(flat_d, k, indices=flat_i)
+        mi = jnp.where(jnp.isfinite(md), mi, -1)
+        return md, mi
+
+    sharded3 = P(comms.axis, None, None)
+    sharded2 = P(comms.axis, None)
+    rep2 = P(None, None)
+    in_specs = (
+        rep2, P(None), P(None),
+        sharded3, sharded3, sharded2, sharded2, sharded2, rep2,
+    )
+    sm = comms.shard_map(body, in_specs=in_specs, out_specs=(rep2, rep2))
+    return jax.jit(sm)
+
+
+def mnmg_ivf_flat_search(
+    comms: Comms, index: MnmgIVFFlatIndex, queries, k: int, *,
+    n_probes: int = 8, qcap: typing.Union[int, str, None] = None,
+    list_block: int = 32,
+    qcap_max_drop_frac: typing.Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed grouped EXACT search over a list-sharded IVF-Flat
+    index. Returns (distances, GLOBAL row ids), both (nq, k) replicated
+    on every chip; distances are sqrt'd for ``metric='l2'`` (squared for
+    ``'sqeuclidean'``), exactly as the single-chip
+    :func:`raft_tpu.spatial.ann.ivf_flat.ivf_flat_search_grouped`.
+    Recall parity with the single-chip search on the same data holds by
+    construction — each probed list is scored by exactly one chip with
+    the same kernel (tests/test_mnmg_ivf_flat.py asserts it on an
+    8-device mesh).
+
+    ``qcap`` as in the single-chip grouped search (``None`` = recall-safe
+    auto from the global probe map; ``"throughput"`` = ~0.75x mean
+    occupancy — see ann.common.throughput_qcap for when that is unsafe).
+    """
+    q = jnp.asarray(queries)
+    errors.check_matrix(q, "queries")
+    errors.check_same_cols(q, index.centroids, "queries", "index")
+    errors.expects(
+        k <= n_probes * index.max_list,
+        "k=%d exceeds the candidate pool (n_probes*max_list=%d)",
+        k, n_probes * index.max_list,
+    )
+    errors.expects(
+        k <= index.max_list,
+        "k=%d exceeds max_list=%d — a single list cannot fill a "
+        "per-list top-k row; lower k or rebuild with fewer lists",
+        k, index.max_list,
+    )
+    nl_g = index.centroids.shape[0]
+    qcap, _ = resolve_qcap_arg(
+        qcap, q, index.centroids, nl_g, n_probes,
+        max_drop_frac=qcap_max_drop_frac,
+    )
+    list_block = max(1, min(list_block, index.nl_pad))
+    statics = (
+        k, n_probes, qcap, list_block, index.n_pad, index.nl_pad,
+        index.max_list,
+    )
+    fn = _cached_search(comms.mesh, comms.axis, statics)
+    vals, ids = fn(
+        index.centroids, index.owner, index.local_id, index.local_cents,
+        index.vectors_sorted, index.sorted_ids, index.list_offsets,
+        index.list_sizes, q,
+    )
+    if index.metric == "l2":
+        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+    return vals, ids

@@ -129,9 +129,11 @@ def ivf_flat_search(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "n_probes", "qcap", "list_block"),
+    static_argnames=("k", "n_probes", "qcap", "list_block",
+                     "stream_partials"),
 )
-def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None):
+def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
+                  stream_partials=None):
     storage = index.storage
     n_lists = storage.list_index.shape[0]
     L = storage.max_list
@@ -140,13 +142,17 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None):
     f32 = jnp.float32
     qf = q.astype(f32)
 
-    from raft_tpu.spatial.ann.common import coarse_probe, invert_probe_map
+    from raft_tpu.spatial.ann.common import (
+        coarse_probe, invert_probe_map_ranked,
+    )
 
     if probes is None:
         probes, _ = coarse_probe(qf, index.centroids, p)     # (nq, p)
     # invert the probe map: for each list, the (padded) set of queries
     # probing it (shared grouped-search machinery, common.py)
-    qmat, l_flat, slot = invert_probe_map(probes, n_lists, qcap)
+    qmat, rmat, l_flat, slot = invert_probe_map_ranked(
+        probes, n_lists, qcap
+    )
 
     q_pad = jnp.concatenate([qf, jnp.zeros((1, d), f32)])    # sentinel query
     qn_pad = jnp.concatenate(
@@ -185,21 +191,44 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None):
         return -vals, memp
 
     # pad the list axis up to a multiple of list_block (clamped ids — the
-    # padded slots recompute the last list; regroup never references them)
-    # instead of shrinking list_block, which collapses to 1-list blocks
-    # when n_lists is prime-ish (e.g. after oversized-list splitting)
+    # padded slots recompute the last list; regroup never references
+    # them, and the streamed scatter re-writes identical values) instead
+    # of shrinking list_block, which collapses to 1-list blocks when
+    # n_lists is prime-ish (e.g. after oversized-list splitting)
     nl_pad = -(-n_lists // list_block) * list_block
     lids = jnp.minimum(
         jnp.arange(nl_pad, dtype=jnp.int32), n_lists - 1
     ).reshape(-1, list_block)
-    vals, mem = lax.map(block_fn, lids)
-    vals = vals.reshape(nl_pad, qcap, k)[:n_lists]
-    mem = mem.reshape(nl_pad, qcap, k)[:n_lists]
 
-    # per-pair result gather (original query-major order), then final k
-    from raft_tpu.spatial.ann.common import regroup_pairs
+    if stream_partials is None:
+        # auto: stream once materialized (n_lists, qcap, k) partials pass
+        # ~2 GB (same skewed-qcap blow-up bound as the PQ grouped search)
+        stream_partials = n_lists * qcap * k * 8 > (1 << 31)
+    if stream_partials:
+        def scan_body(carry, lblk):
+            pvc, pmc = carry
+            v, mp = block_fn(lblk)
+            qi, ri = qmat[lblk], rmat[lblk]          # sentinels drop
+            pvc = pvc.at[qi, ri].set(v, mode="drop")
+            pmc = pmc.at[qi, ri].set(mp, mode="drop")
+            return (pvc, pmc), None
 
-    pv, pm = regroup_pairs(vals, mem, l_flat, slot, nq, p, qcap)
+        init = (
+            jnp.full((nq, p, k), jnp.inf, jnp.float32),
+            jnp.full((nq, p, k), storage.n, jnp.int32),
+        )
+        (pv, pm), _ = lax.scan(scan_body, init, lids)
+        pv = pv.reshape(nq, p * k)
+        pm = pm.reshape(nq, p * k)
+    else:
+        vals, mem = lax.map(block_fn, lids)
+        vals = vals.reshape(nl_pad, qcap, k)[:n_lists]
+        mem = mem.reshape(nl_pad, qcap, k)[:n_lists]
+
+        # per-pair result gather (original query-major order), then final
+        from raft_tpu.spatial.ann.common import regroup_pairs
+
+        pv, pm = regroup_pairs(vals, mem, l_flat, slot, nq, p, qcap)
     fvals, fpos = lax.top_k(-pv, k)
     fmem = jnp.take_along_axis(pm, fpos, axis=1)
     ids = storage.sorted_ids[jnp.clip(fmem, 0, storage.n - 1)]
@@ -210,6 +239,8 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None):
 def ivf_flat_search_grouped(
     index: IVFFlatIndex, queries, k: int, *, n_probes: int = 8,
     qcap: typing.Union[int, str, None] = None, list_block: int = 32,
+    stream_partials: typing.Optional[bool] = None,
+    qcap_max_drop_frac: typing.Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Throughput-mode IVF search, grouped by LIST instead of by query —
     the query-side "sorted-by-list batching" (SURVEY.md §7 hard part №3).
@@ -253,11 +284,13 @@ def ivf_flat_search_grouped(
     from raft_tpu.spatial.ann.common import resolve_qcap_arg
 
     qcap, probes = resolve_qcap_arg(
-        qcap, q, index.centroids, n_lists, n_probes
+        qcap, q, index.centroids, n_lists, n_probes,
+        max_drop_frac=qcap_max_drop_frac,
     )
     list_block = max(1, min(list_block, n_lists))
     vals, ids = _grouped_impl(
-        index, q, k, n_probes, qcap, list_block, probes=probes
+        index, q, k, n_probes, qcap, list_block, probes=probes,
+        stream_partials=stream_partials,
     )
     if index.metric == "l2":
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))

@@ -138,6 +138,15 @@ def _encode_rows(blk, coarse_centroids, codebooks, M, ds):
     return lbl.astype(jnp.int32), codes
 
 
+@functools.partial(jax.jit, static_argnames=("M", "ds"))
+def _encode_block_jit(blk, coarse_centroids, codebooks, M, ds):
+    """Module-level jit of :func:`_encode_rows`: quantizers are ARGUMENTS
+    (not trace-time constants), so a same-shape rebuild reuses the
+    compiled executable — the warm-build path the bench's
+    ``build_warm_s`` measures."""
+    return _encode_rows(blk, coarse_centroids, codebooks, M, ds)
+
+
 def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
     """Subsample-trained codebooks + streaming full-dataset encode.
 
@@ -152,9 +161,8 @@ def _train_pq_and_encode_blocked(x, xt, coarse, params, ds, n_codes):
     M = params.pq_dim
     codebooks = _train_pq_codebooks(xt, coarse, params, ds, n_codes)
 
-    @jax.jit
     def encode_one(blk):
-        return _encode_rows(blk, coarse.centroids, codebooks, M, ds)
+        return _encode_block_jit(blk, coarse.centroids, codebooks, M, ds)
 
     B = params.encode_block
     lbl_parts, code_parts = [], []
@@ -449,15 +457,16 @@ def _gather_refine_rows(index, refine_dataset, rpos, f32):
     jax.jit,
     static_argnames=(
         "k", "n_probes", "qcap", "list_block", "refine_ratio",
-        "exact_selection", "approx_recall_target",
+        "exact_selection", "approx_recall_target", "stream_partials",
     ),
 )
 def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
                      refine_dataset=None, probes=None,
-                     exact_selection=False, approx_recall_target=0.95):
+                     exact_selection=False, approx_recall_target=0.95,
+                     stream_partials=None):
     from raft_tpu.spatial.ann.common import (
-        coarse_probe, invert_probe_map, regroup_pairs, score_l2_candidates,
-        select_candidates,
+        coarse_probe, invert_probe_map_ranked, regroup_pairs,
+        score_l2_candidates, select_candidates,
     )
 
     storage = index.storage
@@ -477,7 +486,9 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
 
     if probes is None:
         probes, _ = coarse_probe(qf, cents, p)               # (nq, p)
-    qmat, l_flat, slot = invert_probe_map(probes, n_lists, qcap)
+    qmat, rmat, l_flat, slot = invert_probe_map_ranked(
+        probes, n_lists, qcap
+    )
 
     q_pad = jnp.concatenate([qf, jnp.zeros((1, d), f32)])    # sentinel query
     # per-(list, query) partial width: must cover the REFINE pool, not just
@@ -556,18 +567,48 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
         return vals, memp
 
     # pad the list axis up to a multiple of list_block (clamped ids — the
-    # padded slots recompute the last list; regroup never references them)
+    # padded slots recompute the last list; regroup never references
+    # them, and the streamed scatter re-writes identical values)
     # instead of shrinking list_block, which collapses to 1-list blocks
     # when n_lists is prime-ish (e.g. after oversized-list splitting)
     nl_pad = -(-n_lists // list_block) * list_block
     lids = jnp.minimum(
         jnp.arange(nl_pad, dtype=jnp.int32), n_lists - 1
     ).reshape(-1, list_block)
-    vals, mem = lax.map(block_fn, lids)
-    vals = vals.reshape(nl_pad, qcap, kk)[:n_lists]
-    mem = mem.reshape(nl_pad, qcap, kk)[:n_lists]
 
-    pv, pm = regroup_pairs(vals, mem, l_flat, slot, nq, p, qcap)
+    if stream_partials is None:
+        # auto: stream once the materialized partials pass ~2 GB. qcap
+        # must cover the HOT list, so on skewed probe maps
+        # n_lists * qcap can exceed the true pair count nq * p by 30x+ —
+        # the buffer compile-OOM'd at 11.8 GB at 3M x 768 rr=16
+        # (docs/ivf_scale.md; VERDICT r4 weak-5)
+        stream_partials = n_lists * qcap * kk * 8 > (1 << 31)
+    if stream_partials:
+        # stream list blocks through the query-major pool: scatter each
+        # block's (LB, qcap, kk) partials straight to their (query,
+        # probe-rank) rows via the slot inverse — peak extra memory is
+        # ONE block's partials, the reference's grid-stride bounding of
+        # the same intermediate (pairwise_distance_base.cuh:122-134)
+        def scan_body(carry, lblk):
+            pvc, pmc = carry
+            v, mp = block_fn(lblk)
+            qi, ri = qmat[lblk], rmat[lblk]          # sentinels drop
+            pvc = pvc.at[qi, ri].set(v, mode="drop")
+            pmc = pmc.at[qi, ri].set(mp, mode="drop")
+            return (pvc, pmc), None
+
+        init = (
+            jnp.full((nq, p, kk), jnp.inf, jnp.float32),
+            jnp.full((nq, p, kk), storage.n, jnp.int32),
+        )
+        (pv, pm), _ = lax.scan(scan_body, init, lids)
+        pv = pv.reshape(nq, p * kk)
+        pm = pm.reshape(nq, p * kk)
+    else:
+        vals, mem = lax.map(block_fn, lids)
+        vals = vals.reshape(nl_pad, qcap, kk)[:n_lists]
+        mem = mem.reshape(nl_pad, qcap, kk)[:n_lists]
+        pv, pm = regroup_pairs(vals, mem, l_flat, slot, nq, p, qcap)
 
     if not refine:
         return select_candidates(storage, pm, pv, k)
@@ -597,6 +638,8 @@ def ivf_pq_search_grouped(
     qcap: typing.Union[int, str, None] = None, list_block: int = 8,
     refine_ratio: float = 2.0, refine_dataset=None,
     exact_selection: bool = False, approx_recall_target: float = 0.95,
+    stream_partials: typing.Optional[bool] = None,
+    qcap_max_drop_frac: typing.Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Throughput-mode IVF-PQ search, grouped by LIST (the PQ counterpart
     of :func:`ivf_flat_search_grouped`; SURVEY.md §7 hard part №3).
@@ -645,6 +688,15 @@ def ivf_pq_search_grouped(
     stages without disabling refinement (the pre-r03 behavior);
     ``approx_recall_target`` tunes the approximate stages instead
     (default 0.95). Unrefined searches always select exactly.
+
+    ``stream_partials``: stream list blocks through the query-major
+    candidate pool instead of materializing the (n_lists, qcap, kk)
+    per-block partials — bounds HBM to one block's partials + the pool
+    when hot-list-skewed probe maps force qcap far above the mean
+    occupancy (the 3M x 768 rr=16 regime that otherwise compile-OOMs at
+    11.8 GB). ``None`` (default) auto-streams past a ~2 GB partials
+    footprint; the materialized path is kept for small buffers where the
+    one-shot regroup measures faster.
     """
     from raft_tpu.spatial.ann.common import (
         check_candidate_pool, resolve_qcap_arg,
@@ -660,7 +712,8 @@ def ivf_pq_search_grouped(
     )
     n_lists = index.centroids.shape[0]
     qcap, probes = resolve_qcap_arg(
-        qcap, q, index.centroids, n_lists, n_probes
+        qcap, q, index.centroids, n_lists, n_probes,
+        max_drop_frac=qcap_max_drop_frac,
     )
     list_block = max(1, min(list_block, n_lists))
     return _pq_grouped_impl(
@@ -668,4 +721,5 @@ def ivf_pq_search_grouped(
         refine_dataset=refine_dataset, probes=probes,
         exact_selection=exact_selection,
         approx_recall_target=approx_recall_target,
+        stream_partials=stream_partials,
     )

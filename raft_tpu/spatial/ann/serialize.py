@@ -47,9 +47,12 @@ def _register_sharded() -> None:
     # re-place onto a mesh with comms.mnmg_ivf.place_index before search.
     if "mnmg_ivf_pq" not in _TYPES:
         from raft_tpu.comms.mnmg_ivf import MnmgIVFPQIndex
+        from raft_tpu.comms.mnmg_ivf_flat import MnmgIVFFlatIndex
 
         _TYPES["mnmg_ivf_pq"] = MnmgIVFPQIndex
         _NAMES[MnmgIVFPQIndex] = "mnmg_ivf_pq"
+        _TYPES["mnmg_ivf_flat"] = MnmgIVFFlatIndex
+        _NAMES[MnmgIVFFlatIndex] = "mnmg_ivf_flat"
 
 
 _NAMES = {v: k for k, v in _TYPES.items()}
@@ -173,7 +176,9 @@ def load_index(path, comms=None):
             "load_index: unknown index type %r", header.get("type"),
         )
         placer = _default_placer
-        if comms is not None and header["type"] == "mnmg_ivf_pq":
+        if comms is not None and header["type"] in (
+            "mnmg_ivf_pq", "mnmg_ivf_flat",
+        ):
             import jax
 
             from raft_tpu.comms.mnmg_ivf import (

@@ -1,0 +1,129 @@
+"""Sharded multi-chip IVF-Flat (comms/mnmg_ivf_flat.py) on the 8-device
+virtual CPU mesh — recall parity with the single-chip grouped search and
+full-probe exactness (the reference's FAISS IVF-Flat role,
+ann_quantized_faiss.cuh:115-142, at the 10-60M multi-chip regime)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.comms import (
+    build_comms,
+    mnmg_ivf_flat_build,
+    mnmg_ivf_flat_search,
+)
+from raft_tpu.random import make_blobs
+from raft_tpu.random.rng import RngState
+from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
+from raft_tpu.spatial.knn import brute_force_knn
+
+
+def recall(got, true):
+    return sum(
+        len(set(g.tolist()) & set(t.tolist())) for g, t in zip(got, true)
+    ) / true.size
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    x, _ = make_blobs(12_000, 24, n_clusters=32, cluster_std=1.0,
+                      state=RngState(13))
+    key = jax.random.PRNGKey(6)
+    q = jnp.take(
+        x, jax.random.randint(key, (128,), 0, x.shape[0]), axis=0
+    ) + 0.2 * jax.random.normal(
+        jax.random.fold_in(key, 1), (128, 24), jnp.float32
+    )
+    _, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    return np.asarray(x), np.asarray(q), np.asarray(bi)
+
+
+@pytest.fixture(scope="module")
+def comms():
+    return build_comms(jax.devices()[:8])
+
+
+PARAMS = IVFFlatParams(n_lists=48, kmeans_n_iters=8, seed=4)
+
+
+@pytest.fixture(scope="module")
+def sharded_index(dataset, comms):
+    x, _, _ = dataset
+    return mnmg_ivf_flat_build(comms, x, PARAMS, metric="sqeuclidean")
+
+
+def test_recall_parity_with_single_chip(dataset, comms, sharded_index):
+    x, q, bi = dataset
+    single = ivf_flat_build(x, PARAMS, metric="sqeuclidean")
+    _, i1 = ivf_flat_search_grouped(
+        single, q, 10, n_probes=12, qcap=q.shape[0]
+    )
+    r_single = recall(np.asarray(i1), bi)
+
+    d2, i2 = mnmg_ivf_flat_search(
+        comms, sharded_index, q, 10, n_probes=12, qcap=q.shape[0]
+    )
+    r_mnmg = recall(np.asarray(i2), bi)
+    # each probed list is scored exactly by one chip -> parity (the
+    # quantizers differ only via the training subsample draw)
+    assert r_mnmg >= r_single - 0.02, (r_single, r_mnmg)
+    assert r_mnmg > 0.9, r_mnmg
+    d2 = np.asarray(d2)
+    assert (np.diff(d2, axis=1) >= -1e-5).all()
+    i2 = np.asarray(i2)
+    assert ((i2 >= 0) & (i2 < x.shape[0])).all()
+
+
+def test_full_probe_is_exact(dataset, comms, sharded_index):
+    """Probing every list = exact brute force: recall 1.0 and true
+    squared distances (the recall-1.0 engine claim, measured)."""
+    x, q, bi = dataset
+    nl = int(np.asarray(sharded_index.centroids).shape[0])
+    d2, ids = mnmg_ivf_flat_search(
+        comms, sharded_index, q, 10, n_probes=nl, qcap=q.shape[0]
+    )
+    assert recall(np.asarray(ids), bi) == 1.0
+    true = ((q[:, None, :] - x[np.asarray(ids)]) ** 2).sum(-1)
+    np.testing.assert_allclose(np.asarray(d2), true, rtol=1e-4, atol=1e-2)
+
+
+def test_rows_cover_all_shards(dataset, comms, sharded_index):
+    x, _, _ = dataset
+    sids = np.asarray(sharded_index.sorted_ids)
+    szs = np.asarray(sharded_index.list_sizes)
+    got = np.concatenate([
+        sids[r, : szs[r].sum()] for r in range(comms.size)
+    ])
+    assert got.shape[0] == x.shape[0]
+    assert np.array_equal(np.sort(got), np.arange(x.shape[0]))
+
+
+def test_l2_metric_sqrt(dataset, comms):
+    x, q, _ = dataset
+    idx = mnmg_ivf_flat_build(comms, x, PARAMS, metric="l2")
+    d_l2, i_l2 = mnmg_ivf_flat_search(
+        comms, idx, q, 5, n_probes=12, qcap=q.shape[0]
+    )
+    true = np.sqrt(((q[:, None, :] - x[np.asarray(i_l2)]) ** 2).sum(-1))
+    np.testing.assert_allclose(np.asarray(d_l2), true, rtol=1e-4,
+                               atol=1e-2)
+
+
+def test_serialization_roundtrip(tmp_path, dataset, comms, sharded_index):
+    from raft_tpu.spatial.ann import load_index, save_index
+
+    _, q, _ = dataset
+    p = tmp_path / "mnmg_flat.npz"
+    save_index(sharded_index, p)
+    d1, i1 = mnmg_ivf_flat_search(
+        comms, sharded_index, q, 10, n_probes=12, qcap=q.shape[0]
+    )
+    loaded = load_index(p, comms=comms)  # direct-to-mesh streaming
+    assert "ranks" in str(loaded.vectors_sorted.sharding)
+    d2, i2 = mnmg_ivf_flat_search(
+        comms, loaded, q, 10, n_probes=12, qcap=q.shape[0]
+    )
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
