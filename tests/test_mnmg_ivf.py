@@ -24,13 +24,17 @@ def recall(got, true):
 
 @pytest.fixture(scope="module")
 def dataset():
-    x, _ = make_blobs(20_000, 32, n_clusters=40, cluster_std=1.0,
+    # sized for CI wall time (VERDICT r4 next-9): the distributed build's
+    # CPU-mesh cost is dominated by bf16-emulated kmeans matmuls, which
+    # scale with n*d*n_lists — 8k x 24 exercises every code path (split,
+    # LPT, exchange rounds, refinement) at ~1/5 the 20k x 32 cost
+    x, _ = make_blobs(8_000, 24, n_clusters=40, cluster_std=1.0,
                       state=RngState(11))
     key = jax.random.PRNGKey(5)
     q = jnp.take(
-        x, jax.random.randint(key, (256,), 0, x.shape[0]), axis=0
+        x, jax.random.randint(key, (192,), 0, x.shape[0]), axis=0
     ) + 0.2 * jax.random.normal(
-        jax.random.fold_in(key, 1), (256, 32), jnp.float32
+        jax.random.fold_in(key, 1), (192, 24), jnp.float32
     )
     _, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
     return np.asarray(x), np.asarray(q), np.asarray(bi)
@@ -42,7 +46,7 @@ def comms():
 
 
 PARAMS = IVFPQParams(
-    n_lists=64, pq_dim=8, kmeans_n_iters=8, seed=3, max_list_cap=1024
+    n_lists=48, pq_dim=8, kmeans_n_iters=6, seed=3, max_list_cap=512
 )
 
 
@@ -105,19 +109,25 @@ def test_rows_cover_all_shards(dataset, comms, sharded_index):
     assert np.array_equal(np.sort(got), np.arange(x.shape[0]))
 
 
-def test_codes_only_unrefined(dataset, comms):
-    """store_raw=False shards search unrefined (ADC distances)."""
-    x, q, bi = dataset
-    import dataclasses
-
+def test_codes_only_unrefined(comms):
+    """store_raw=False shards search unrefined (ADC distances). Small
+    standalone dataset: this only checks the no-raw-slab path, so it
+    must not pay a second full-size build (CI wall time, VERDICT r4
+    next-9)."""
+    x, _ = make_blobs(2_500, 16, n_clusters=10, state=RngState(9))
+    x = np.asarray(x)
+    q = x[:64]
+    _, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
     idx = mnmg_ivf_pq_build(
-        comms, x, dataclasses.replace(PARAMS, store_raw=False)
+        comms, x,
+        IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=4, seed=3,
+                    store_raw=False),
     )
     assert idx.vectors_sorted is None
     _, ids = mnmg_ivf_pq_search(
-        comms, idx, q, 10, n_probes=16, refine_ratio=4.0, qcap=q.shape[0]
+        comms, idx, q, 10, n_probes=8, refine_ratio=4.0, qcap=q.shape[0]
     )
-    assert recall(np.asarray(ids), bi) > 0.5
+    assert recall(np.asarray(ids), np.asarray(bi)) > 0.5
 
 
 def test_sharded_index_serialization_roundtrip(tmp_path, dataset, comms,
