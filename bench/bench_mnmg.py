@@ -124,6 +124,24 @@ def bench_weak_scaling():
 
         run_knn(run_ivf, "ivf_pq_sharded")
 
+        # ---- sharded IVF-Flat: exact scoring at list granularity -----
+        from raft_tpu.comms.mnmg_ivf_flat import (
+            mnmg_ivf_flat_build, mnmg_ivf_flat_search,
+        )
+        from raft_tpu.spatial.ann import IVFFlatParams
+
+        fidx = mnmg_ivf_flat_build(
+            comms, x, IVFFlatParams(n_lists=32, kmeans_n_iters=6, seed=0),
+            metric="sqeuclidean",
+        )
+
+        def run_flat(_c, _x, _q, _k):
+            return mnmg_ivf_flat_search(
+                _c, fidx, _q, _k, n_probes=8, qcap=nq,
+            )
+
+        run_knn(run_flat, "ivf_flat_sharded")
+
 
 def main():
     bench_weak_scaling()

@@ -95,6 +95,24 @@ class MnmgIVFPQIndex:
     n_rows: int = dataclasses.field(metadata=dict(static=True))
 
 
+# bounded cache of compiled build-phase shard_map programs keyed on
+# (kind, mesh, axis, statics): the single-chip build reuses executables
+# through module-level jits (_encode_block_jit), and a distributed
+# same-shape rebuild deserves the same — without this every build
+# re-traced and re-compiled all four phase programs (~130 s of the 150 s
+# warm mnmg build at the 500k bench shape was recompilation)
+_PROGRAM_CACHE: dict = {}
+
+
+def _cached_program(key, make):
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is None:
+        if len(_PROGRAM_CACHE) >= 64:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        fn = _PROGRAM_CACHE[key] = make()
+    return fn
+
+
 def _lpt_assign(sizes: np.ndarray, n_ranks: int):
     """Greedy longest-processing-time list→rank assignment: biggest list
     to the least-loaded rank. Returns (owner (nl,), local_id (nl,),
@@ -266,25 +284,32 @@ def mnmg_ivf_pq_build_distributed(
     B = max(1, min(nloc, params.encode_block))
     nb = _cdiv_host(nloc, B)
 
-    def enc_body(x_sh, nv_sh, cents_in, cbs_in):
-        xb, nvr = x_sh[0], nv_sh[0]
-        xp = jnp.pad(xb, ((0, nb * B - nloc), (0, 0)))
-        lbl, codes = lax.map(
-            lambda blk: _encode_rows(blk, cents_in, cbs_in, M, ds),
-            xp.reshape(nb, B, d),
-        )
-        lbl = lbl.reshape(-1)[:nloc]
-        codes = codes.reshape(-1, M)[:nloc]
-        valid = jnp.arange(nloc, dtype=jnp.int32) < nvr
-        cnt = jnp.zeros((nl + 1,), jnp.int32).at[
-            jnp.where(valid, lbl, nl)
-        ].add(1)[:nl]
-        return lbl[None], codes[None], ax.allgather(cnt)
+    def make_enc():
+        def enc_body(x_sh, nv_sh, cents_in, cbs_in):
+            xb, nvr = x_sh[0], nv_sh[0]
+            xp = jnp.pad(xb, ((0, nb * B - nloc), (0, 0)))
+            lbl, codes = lax.map(
+                lambda blk: _encode_rows(blk, cents_in, cbs_in, M, ds),
+                xp.reshape(nb, B, d),
+            )
+            lbl = lbl.reshape(-1)[:nloc]
+            codes = codes.reshape(-1, M)[:nloc]
+            valid = jnp.arange(nloc, dtype=jnp.int32) < nvr
+            cnt = jnp.zeros((nl + 1,), jnp.int32).at[
+                jnp.where(valid, lbl, nl)
+            ].add(1)[:nl]
+            return lbl[None], codes[None], ax.allgather(cnt)
 
-    lbl_g, codes_g, C = jax.jit(comms.shard_map(
-        enc_body, in_specs=(sh3, sh1, rep, rep),
-        out_specs=(sh2, sh3, rep),
-    ))(x, n_valid, cents, codebooks)
+        return jax.jit(comms.shard_map(
+            enc_body, in_specs=(sh3, sh1, rep, rep),
+            out_specs=(sh2, sh3, rep),
+        ))
+
+    lbl_g, codes_g, C = _cached_program(
+        ("enc", comms.mesh, comms.axis, Pn, nloc, d, B, nb, M, ds, nl,
+         str(x.dtype)),
+        make_enc,
+    )(x, n_valid, cents, codebooks)
 
     cap = (
         params.max_list_cap
@@ -346,19 +371,26 @@ def _train_coarse_distributed(
     t_per = _cdiv_host(train_n, max(keep.size, 1))
     key0 = jax.random.PRNGKey(seed)
 
-    def sub_body(x_sh, nv_sh):
-        xb, nvr = x_sh[0], nv_sh[0]
-        key = jax.random.fold_in(key0, ax.get_rank())
-        sel = jax.random.permutation(key, nloc)[:t_per]
-        sel = jnp.where(sel < nvr, sel, sel % jnp.maximum(nvr, 1))
-        return ax.allgather(jnp.take(xb, sel, axis=0))       # (P, t_per, d)
+    def make_sub():
+        def sub_body(x_sh, nv_sh, key_in):
+            xb, nvr = x_sh[0], nv_sh[0]
+            key = jax.random.fold_in(key_in, ax.get_rank())
+            sel = jax.random.permutation(key, nloc)[:t_per]
+            sel = jnp.where(sel < nvr, sel, sel % jnp.maximum(nvr, 1))
+            g = ax.allgather(jnp.take(xb, sel, axis=0))      # (P, t_per, d)
+            # static keep-filter folded into the program: empty ranks'
+            # all-padding slots never reach quantizer training
+            return g[keep].reshape(keep.size * t_per, d)
 
-    sub = jax.jit(comms.shard_map(
-        sub_body, in_specs=(sh3, sh1), out_specs=rep,
-    ))(x, n_valid)
-    xt = jax.jit(
-        lambda a: a[keep].reshape(keep.size * t_per, d)
-    )(sub)
+        return jax.jit(comms.shard_map(
+            sub_body, in_specs=(sh3, sh1, P(None)), out_specs=rep,
+        ))
+
+    xt = _cached_program(
+        ("sub", comms.mesh, comms.axis, Pn, nloc, d, t_per,
+         tuple(keep.tolist()), str(x.dtype)),
+        make_sub,
+    )(x, n_valid, key0)
 
     coarse = kmeans_fit(
         xt,
@@ -425,7 +457,15 @@ def _exchange_and_assemble(
         ssz = sizes
 
     owner, local_id, loads, lists_per = _lpt_assign(ssz, Pn)
-    n_pad = max(int(loads.max()), 1)
+    # bucket the slab height: raw max-load is data-dependent, so a
+    # same-shape rebuild (or an incremental re-ingest) would shift n_pad
+    # by a handful of rows and recompile BOTH the assembly program and
+    # every search program keyed on it; rounding up to a coarse bucket
+    # (<= ~6% slab padding) keeps the statics — and the compiled
+    # programs — stable across rebuilds
+    raw_npad = max(int(loads.max()), 1)
+    bucket = 256 if raw_npad < (1 << 17) else 4096
+    n_pad = _cdiv_host(raw_npad, bucket) * bucket
     nl_pad = int(lists_per.max()) + 1          # +1 empty sentinel list
     max_list = max(int(ssz.max()), 1)
 
@@ -485,10 +525,14 @@ def _exchange_and_assemble(
         dcnt = jnp.zeros((Pn + 1,), jnp.int32).at[dest].add(1)[:Pn]
         return dest[None], pos[None], wslot[None], ax.allgather(dcnt)
 
-    dest_g, pos_g, wslot_g, C2 = jax.jit(comms.shard_map(
-        route_body, in_specs=(sh2, sh1, rep, rep, rep, rep, rep),
-        out_specs=(sh2, sh2, sh2, rep),
-    ))(lbl_g, n_valid, C, owner, local_id, base_np, offs_sh)
+    dest_g, pos_g, wslot_g, C2 = _cached_program(
+        ("route", comms.mesh, comms.axis, Pn, nloc, nl, cap,
+         owner.shape[0], offs_sh.shape[1]),
+        lambda: jax.jit(comms.shard_map(
+            route_body, in_specs=(sh2, sh1, rep, rep, rep, rep, rep),
+            out_specs=(sh2, sh2, sh2, rep),
+        )),
+    )(lbl_g, n_valid, C, owner, local_id, base_np, offs_sh)
     C2_np = np.asarray(C2)                                   # (src, dst)
     max_send = max(1, int(C2_np.max()))
 
@@ -571,10 +615,17 @@ def _exchange_and_assemble(
         (sh2,) + ((sh3,) if with_codes else ())
         + ((sh3,) if store_vectors else ())
     )
-    res = jax.jit(comms.shard_map(
-        asm_body, in_specs=(sh3, sh3, sh2, sh2, sh2, sh1, rep),
-        out_specs=out_specs,
-    ))(x, codes_in, dest_g, pos_g, wslot_g, gb_np, C2)
+    res = _cached_program(
+        # keyed on (ms_r, n_rounds), NOT raw max_send: the body only
+        # depends on the round geometry, and max_send shifts by a few
+        # rows between same-shape rebuilds
+        ("asm", comms.mesh, comms.axis, Pn, nloc, d, M, ms_r,
+         n_rounds, n_pad, with_codes, store_vectors, str(x.dtype)),
+        lambda: jax.jit(comms.shard_map(
+            asm_body, in_specs=(sh3, sh3, sh2, sh2, sh2, sh1, rep),
+            out_specs=out_specs,
+        )),
+    )(x, codes_in, dest_g, pos_g, wslot_g, gb_np, C2)
     slabs = {"sids": res[0]}
     i = 1
     if with_codes:

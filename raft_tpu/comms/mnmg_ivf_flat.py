@@ -45,6 +45,7 @@ from raft_tpu import errors
 from raft_tpu.cluster.kmeans import kmeans_predict
 from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.mnmg_ivf import (
+    _cached_program,
     _cdiv_host,
     _exchange_and_assemble,
     _P3,
@@ -184,9 +185,13 @@ def mnmg_ivf_flat_build_distributed(
         ].add(1)[:nl]
         return lbl[None], ax.allgather(cnt)
 
-    lbl_g, C = jax.jit(comms.shard_map(
-        asg_body, in_specs=(sh3, sh1, rep), out_specs=(sh2, rep),
-    ))(x, n_valid, cents)
+    lbl_g, C = _cached_program(
+        ("asg", comms.mesh, comms.axis, Pn, nloc, d, B, nb, nl,
+         str(x.dtype)),
+        lambda: jax.jit(comms.shard_map(
+            asg_body, in_specs=(sh3, sh1, rep), out_specs=(sh2, rep),
+        )),
+    )(x, n_valid, cents)
 
     cap = (
         params.max_list_cap
