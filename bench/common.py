@@ -94,7 +94,7 @@ def bench_fn(make_fn: Callable, *args, iters: int = 40, name: str = "",
 
 
 def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
-                           reps: int = 3):
+                           reps: int = 3, escalate: int = 0):
     """Two-point timing for programs too large for the loop-in-jit harness
     (Pallas grid-step limits, multi-hundred-MB working sets): dispatch a
     chain of ``run(input_i + prev * 0)`` calls — device-serialized by the
@@ -113,6 +113,11 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
     google-benchmark repeated-iteration discipline,
     cpp/bench/common/benchmark.hpp:64). None when all quotients are
     non-positive (jitter-dominated: too fast to resolve this way).
+
+    ``escalate``: on a jitter-dominated result, retry up to this many
+    times with 4x-longer chains — the one shared knob for
+    millisecond-scale programs whose signal must be stretched above the
+    1-core host's dispatch noise (no per-call-site hand-rolled retries).
     """
     def reduce_finite(out):
         leaf = jax.tree.leaves(out)[0]
@@ -138,6 +143,11 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
     # masquerade as a confident measurement on a jitter-dominated workload
     ms = sorted(quotients)[len(quotients) // 2]
     if ms <= 0:
+        if escalate > 0:
+            return chained_dispatch_stats(
+                make_input, run, n1=4 * n1, n2=4 * n2, reps=reps,
+                escalate=escalate - 1,
+            )
         return None
     pos = sorted(q for q in quotients if q > 0)
     return {

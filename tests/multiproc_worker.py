@@ -102,6 +102,23 @@ def main() -> None:
         and np.allclose(np.asarray(dq2), np.asarray(dq), rtol=1e-5)
     )
 
+    # sharded IVF-Flat across the same process boundary: exact scoring,
+    # so full-probe self-search must return exact self-neighbors
+    from raft_tpu.comms import mnmg_ivf_flat_build, mnmg_ivf_flat_search
+    from raft_tpu.spatial.ann import IVFFlatParams
+
+    fidx = mnmg_ivf_flat_build(
+        comms, x, IVFFlatParams(n_lists=8, kmeans_n_iters=4, seed=0),
+        metric="sqeuclidean",
+    )
+    df, jf = mnmg_ivf_flat_search(
+        comms, fidx, x[:16], 3, n_probes=8, qcap=16,
+    )
+    flat_self = bool(
+        (np.asarray(jf)[:, 0] == np.arange(16)).all()
+        and float(np.asarray(df)[:, 0].max()) < 1e-2
+    )
+
     print(json.dumps({
         "rank": rank,
         "process_count": jax.process_count(),
@@ -113,6 +130,7 @@ def main() -> None:
         "ivf_self_recall": ivf_self,
         "ivf_ids_sum": int(iq_np.sum()),
         "ivf_dist_build_matches": dist_matches_wrapper,
+        "ivf_flat_self_exact": flat_self,
     }), flush=True)
 
 

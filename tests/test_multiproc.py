@@ -102,3 +102,10 @@ def test_distributed_build_per_rank_rows_across_processes(worker_reports):
     the one-host wrapper build (VERDICT r4 item 1 'done' criterion)."""
     for r in worker_reports:
         assert r["ivf_dist_build_matches"] is True, r
+
+
+def test_mnmg_ivf_flat_across_processes(worker_reports):
+    """Sharded IVF-Flat under real multi-process jax.distributed: exact
+    scoring returns exact self-neighbors on every rank."""
+    for r in worker_reports:
+        assert r["ivf_flat_self_exact"] is True, r
