@@ -232,9 +232,9 @@ def extra_kmeans():
         "bf16_iters_per_s": round(1.0 / bf16, 2),
         "bf16_spread": bf16_spread,
         # r02->r04 bf16 drop (133.6 -> ~101) bisected in r5 with the
-        # worktree method (scratch/bisect_kmeans_bf16.py): the r02
-        # LIBRARY remeasures 93.8 iters/s on the r5 runtime vs 104.9 for
-        # r5 code — runtime drift, not a code regression (r5 code is
+        # worktree method (r02 library checked out and remeasured on the
+        # r5 runtime): the r02 LIBRARY remeasures 93.8 iters/s vs 104.9
+        # for r5 code — runtime drift, not a code regression (r5 code is
         # faster than r02 code on the same stack)
         "bf16_note": "r02 lib remeasured 93.8 vs r5 lib 104.9 on r5 "
                      "runtime — drift, not code",
@@ -553,11 +553,42 @@ def extra_mnmg_shard_100m():
       probe); the (nq, k) allgather itself is ~2.6 MB over ICI —
       sub-ms, folded into the merge measurement's noise floor.
     """
+    return _mnmg_shard_100m_impl("pq")
+
+
+def extra_mnmg_shard_100m_flat():
+    """Sharded IVF-Flat at the TRUE DEEP-100M shard shape — the engine
+    that actually wins the 100M x 96 deployment on a v5e-8 (r5 finding,
+    docs/ivf_scale.md "Flat beats PQ at the 100M shard shape").
+
+    At d=96 the raw bf16 rows fit the mesh (100M x 96 x 2 B = 19.2 GB =
+    2.4 GB/chip), so the exact-scoring list-sharded IVF-Flat
+    (comms/mnmg_ivf_flat.py) needs no compression: no one-hot ADC
+    materialization, no refinement pool — per-(list, slot) selection is
+    kk = k = 10 instead of the PQ path's rr*k = 80, which is what bounds
+    the PQ shard row under shard_map (exact lax.top_k; the approx-top-k
+    custom call loses its fast lowering there). Measured on the same
+    12.5M x 96 shard/queries as the PQ row: 2.3x the QPS at HIGHER
+    recall (probe-coverage-bound 0.984 vs refinement-bound 0.9575), and
+    6.2x at the real per-chip occupancy qcap=8.
+
+    Fields mirror the PQ shard row so the two engines read side-by-side:
+    ``value`` = full-load qcap-48 QPS, ``qcap8_qps`` = real-occupancy
+    QPS, ``merge8_ms``/``probe32k_ms`` = measured collective-phase
+    costs, ``projected_100m_qps`` = nq / (qcap8 shard + merge + global
+    probe). The PQ index remains the engine when codes-only compression
+    is required (raw rows exceeding the mesh: higher d, fewer chips).
+    Reference: the Flat branch of the FAISS dispatch,
+    ann_quantized_faiss.cuh:115-142."""
+    return _mnmg_shard_100m_impl("flat")
+
+
+def _mnmg_shard_100m_impl(engine: str):
+    """Shared harness for the two true-shard-shape rows: identical data
+    synthesis, search/merge/probe timing, and oracle-recall protocol —
+    only the build and search calls differ, so the engines read
+    side-by-side and a timing fix can never apply to one row only."""
     from raft_tpu.comms import build_comms
-    from raft_tpu.comms.mnmg_ivf import (
-        mnmg_ivf_pq_build_distributed, mnmg_ivf_pq_search,
-    )
-    from raft_tpu.spatial.ann import IVFPQParams
     from raft_tpu.spatial.ann.common import coarse_probe
     from raft_tpu.spatial.knn import brute_force_knn
     from raft_tpu.spatial.selection import select_k
@@ -594,31 +625,64 @@ def extra_mnmg_shard_100m():
         NamedSharding(comms.mesh, PartitionSpec(comms.axis, None, None)),
     )
     t0 = time.perf_counter()
-    idx = mnmg_ivf_pq_build_distributed(comms, xg, IVFPQParams(
-        n_lists=4096, pq_dim=24, kmeans_n_iters=8, kmeans_init="random",
-        train_size=1 << 20, encode_block=1 << 20, store_raw=True,
-    ))
-    float(jnp.sum(idx.codes_sorted[:, -1].astype(jnp.float32)))
+    if engine == "pq":
+        from raft_tpu.comms.mnmg_ivf import (
+            mnmg_ivf_pq_build_distributed, mnmg_ivf_pq_search,
+        )
+        from raft_tpu.spatial.ann import IVFPQParams
+
+        idx = mnmg_ivf_pq_build_distributed(comms, xg, IVFPQParams(
+            n_lists=4096, pq_dim=24, kmeans_n_iters=8,
+            kmeans_init="random", train_size=1 << 20,
+            encode_block=1 << 20, store_raw=True,
+        ))
+        float(jnp.sum(idx.codes_sorted[:, -1].astype(jnp.float32)))
+
+        # refine_ratio=8: the r5 probe/refine sweep at this shape
+        # measured recall REFINEMENT-bound, not probe-bound — p=16/24/32
+        # all plateau at 0.8823 with rr=4, while rr=8 at p=16 buys
+        # recall 0.9575 for only ~5% QPS (6130 -> 5827)
+        def make_search(qcap):
+            def search(qq):
+                return mnmg_ivf_pq_search(
+                    comms, idx, qq, k, n_probes=16, refine_ratio=8.0,
+                    qcap=qcap,
+                )
+            return search
+
+        metric = f"mnmg_ivf_pq_shard_{n}x{d}_q{nq}_k{k}_p16"
+        index_gb = (idx.codes_sorted.nbytes + idx.vectors_sorted.nbytes)
+        fields = {"refine_ratio": 8.0}
+    else:
+        from raft_tpu.comms.mnmg_ivf_flat import (
+            mnmg_ivf_flat_build_distributed, mnmg_ivf_flat_search,
+        )
+        from raft_tpu.spatial.ann import IVFFlatParams
+
+        idx = mnmg_ivf_flat_build_distributed(comms, xg, IVFFlatParams(
+            n_lists=4096, kmeans_n_iters=8, kmeans_init="random",
+        ), metric="sqeuclidean")
+        float(jnp.sum(idx.sorted_ids[:, -1].astype(jnp.float32)))
+
+        def make_search(qcap):
+            def search(qq):
+                return mnmg_ivf_flat_search(
+                    comms, idx, qq, k, n_probes=16, qcap=qcap,
+                )
+            return search
+
+        metric = f"mnmg_ivf_flat_shard_{n}x{d}_q{nq}_k{k}_p16"
+        index_gb = idx.vectors_sorted.nbytes
+        fields = {"note": "exact scoring, no compression needed at d=96 "
+                          "(100M bf16 = 2.4 GB/chip on 8 chips)"}
     build_s = time.perf_counter() - t0  # ~ per-chip share of a 100M build
     del xg  # the resharded build input (2.4 GB) — free HBM for searches
-
-    # refine_ratio=8: the r5 sweep (scratch/shard_sweep.py) measured
-    # recall at this shape REFINEMENT-bound, not probe-bound — p=16/24/32
-    # all plateau at 0.8823 with rr=4, while rr=8 at p=16 buys
-    # recall 0.9575 for only ~5% QPS (6130 -> 5827)
-    def make_search(qcap):
-        def search(qq):
-            return mnmg_ivf_pq_search(
-                comms, idx, qq, k, n_probes=16, refine_ratio=8.0,
-                qcap=qcap,
-            )
-        return search
 
     sim = make_search("throughput")                # resolves to 48 here
     float(jnp.sum(sim(q)[0]))
     st = chained_dispatch_stats(lambda s: q * (1.0 + 1e-6 * s), sim)
     if st is None:
-        return {"metric": "mnmg_shard_100m", "error": "jitter-dominated"}
+        return {"metric": metric, "error": "jitter-dominated"}
 
     real = make_search(8)                          # true global occupancy
     float(jnp.sum(real(q)[0]))
@@ -665,16 +729,15 @@ def extra_mnmg_shard_100m():
     rec = recall_at_k(np.asarray(iv)[:1024], np.asarray(true_ids))
 
     out = {
-        "metric": f"mnmg_ivf_pq_shard_{n}x{d}_q{nq}_k{k}_p16",
+        "metric": metric,
         "value": round(nq / (st["ms"] / 1e3), 1),
         "unit": "QPS",
         "spread": st["spread"],
         "repeats": st["repeats"],
         "recall_at_10_vs_shard": round(rec, 4),
         "build_s": round(build_s, 2),
-        "index_gb": round(
-            (idx.codes_sorted.nbytes + idx.vectors_sorted.nbytes) / 1e9, 2
-        ),
+        "index_gb": round(index_gb / 1e9, 2),
+        **fields,
     }
     if stm is not None:
         out["merge8_ms"] = round(stm["ms"], 2)
@@ -695,10 +758,14 @@ _EXTRAS = {
     "ivf_pq_10m": extra_ivf_pq_10m,
     "mnmg_ivf_pq": extra_mnmg_ivf_pq,
     "mnmg_shard_100m": extra_mnmg_shard_100m,
+    "mnmg_shard_100m_flat": extra_mnmg_shard_100m_flat,
 }
 # per-extra subprocess timeout seconds (default 1200): the 12.5M shard
-# build + two search-program compiles need more headroom
-_EXTRA_TIMEOUT = {"mnmg_shard_100m": 2400, "ivf_pq_10m": 1800}
+# builds + search-program compiles need more headroom
+_EXTRA_TIMEOUT = {
+    "mnmg_shard_100m": 2400, "ivf_pq_10m": 1800,
+    "mnmg_shard_100m_flat": 2400,
+}
 
 
 def _current_round():
@@ -766,7 +833,8 @@ def _load_prev_bench():
 # (VERDICT r4 weak-2: the kmeans bf16 companion lost 24% untracked
 # because vs_prev covered only each row's primary value)
 _COMPANIONS = ("bf16_iters_per_s", "f32_highest_gflops",
-               "brute_force_same_shape_qps", "build_warm_s")
+               "brute_force_same_shape_qps", "build_warm_s",
+               "qcap8_qps", "projected_100m_qps")
 
 
 def _stamp_vs_prev(row, prev):

@@ -94,7 +94,8 @@ def bench_fn(make_fn: Callable, *args, iters: int = 40, name: str = "",
 
 
 def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
-                           reps: int = 3, escalate: int = 0):
+                           reps: int = 3, escalate: int = 0,
+                           _salt0: int = 1):
     """Two-point timing for programs too large for the loop-in-jit harness
     (Pallas grid-step limits, multi-hundred-MB working sets): dispatch a
     chain of ``run(input_i + prev * 0)`` calls — device-serialized by the
@@ -102,9 +103,15 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
     median of ``reps`` difference quotients (T(n2) - T(n1)) / (n2 - n1).
 
     ``make_input(salt)`` must return a fresh input per salt (identical
-    inputs would hit the axon result memoization). The chain dependence is
-    sanitized to finite values so an inf-padded result cannot poison later
-    inputs with NaN. Inputs are materialized before the clock starts.
+    inputs would hit the axon result memoization). Salts increase
+    strictly monotonically across every chain, repeat, AND escalation
+    retry of one invocation, and start at 1 rather than 0 — overlapping
+    bases would replay inputs an earlier chain already ran (and salt 0
+    typically reproduces the caller's unsalted warm-up input), and the
+    memoized prefix deflates that chain's measured time (a ~25% quotient
+    bias at the escalated merge chain lengths). The chain dependence is sanitized to finite values
+    so an inf-padded result cannot poison later inputs with NaN. Inputs
+    are materialized before the clock starts.
 
     Returns ``{"ms", "ms_min", "spread", "repeats"}`` — median, best,
     (max-min)/median relative spread over the positive quotients, and the
@@ -133,10 +140,13 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
         float(prev)
         return time.perf_counter() - t0
 
+    off = _salt0
     quotients = []
     for rep in range(reps):
-        t1 = timed(n1, 10_000 * (rep + 1))
-        t2 = timed(n2, 20_000 * (rep + 1))
+        t1 = timed(n1, off)
+        off += n1
+        t2 = timed(n2, off)
+        off += n2
         quotients.append((t2 - t1) / (n2 - n1) * 1e3)
     # the jitter guard takes the median over ALL quotients (negative ones
     # included): filtering negatives first would let one outlier positive
@@ -146,7 +156,7 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
         if escalate > 0:
             return chained_dispatch_stats(
                 make_input, run, n1=4 * n1, n2=4 * n2, reps=reps,
-                escalate=escalate - 1,
+                escalate=escalate - 1, _salt0=off,
             )
         return None
     pos = sorted(q for q in quotients if q > 0)
