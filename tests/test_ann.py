@@ -571,3 +571,29 @@ def test_throughput_qcap_guardrail(dataset):
         np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
     finally:
         logger.set_callback(None)
+
+
+def test_coarse_probe_chunk_path_matches_topk():
+    """coarse_probe routes wide centroid sets (nl % 128 == 0, nl/128 >=
+    4*n_probes) through the exact chunk-min select — the 100M-scale
+    probe's hot path. Its probes must equal the direct lax.top_k path's
+    (chunk_min_select_k is exact; this pins the routing AND the
+    primitive's index arithmetic at a genuinely-engaged shape, which no
+    other test reaches)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.common import coarse_probe
+    from raft_tpu.spatial.selection import chunk_min_select_k
+
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((37, 24)).astype(np.float32))
+    cents = jnp.asarray(rng.standard_normal((1024, 24)).astype(np.float32))
+    probes, d2 = coarse_probe(q, cents, 2)        # 1024/128 = 8 >= 4*2
+    _, want = jax.lax.top_k(-d2, 2)
+    np.testing.assert_array_equal(np.asarray(probes), np.asarray(want))
+    # the primitive itself, at a wide many-k shape (values AND indices)
+    v1, i1 = chunk_min_select_k(d2, 7)
+    nv, i2 = jax.lax.top_k(-d2, 7)
+    np.testing.assert_allclose(np.asarray(v1), -np.asarray(nv), rtol=0)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
