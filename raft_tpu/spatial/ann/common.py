@@ -51,7 +51,8 @@ def coarse_probe(qf, centroids, n_probes: int, precision=None):
 
     Selection: on wide centroid sets (the 32k-list 100M-scale probe) the
     exact two-stage chunk-min select measures ~1.75x ``lax.top_k``
-    (selection.py chunk_min_select_k — identical results, plain ops so
+    (selection.py chunk_min_select_k — value-exact; tied distances may
+    order differently than top_k's lowest-index tiebreak; plain ops so
     it keeps its speed inside shard_map too); the guard keeps narrow
     probes (bench-shape 2-4k lists, where the candidate gather covers
     most of the row anyway) on the direct path.

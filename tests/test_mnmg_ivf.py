@@ -13,7 +13,7 @@ from raft_tpu.random import make_blobs
 from raft_tpu.random.rng import RngState
 from raft_tpu.spatial.ann import IVFPQParams, ivf_pq_build
 from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
-from raft_tpu.spatial.knn import brute_force_knn
+from tests.conftest import np_knn_ids
 
 
 def recall(got, true):
@@ -36,8 +36,8 @@ def dataset():
     ) + 0.2 * jax.random.normal(
         jax.random.fold_in(key, 1), (192, 24), jnp.float32
     )
-    _, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
-    return np.asarray(x), np.asarray(q), np.asarray(bi)
+    bi = np_knn_ids(x, q, 10)
+    return np.asarray(x), np.asarray(q), bi
 
 
 @pytest.fixture(scope="module")
@@ -117,7 +117,7 @@ def test_codes_only_unrefined(comms):
     x, _ = make_blobs(2_500, 16, n_clusters=10, state=RngState(9))
     x = np.asarray(x)
     q = x[:64]
-    _, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    bi = np_knn_ids(x, q, 10)
     idx = mnmg_ivf_pq_build(
         comms, x,
         IVFPQParams(n_lists=16, pq_dim=4, kmeans_n_iters=4, seed=3,
@@ -127,7 +127,7 @@ def test_codes_only_unrefined(comms):
     _, ids = mnmg_ivf_pq_search(
         comms, idx, q, 10, n_probes=8, refine_ratio=4.0, qcap=q.shape[0]
     )
-    assert recall(np.asarray(ids), np.asarray(bi)) > 0.5
+    assert recall(np.asarray(ids), bi) > 0.5
 
 
 def test_sharded_index_serialization_roundtrip(tmp_path, dataset, comms,
@@ -252,7 +252,7 @@ def test_fewer_lists_than_ranks(comms):
     x, _ = make_blobs(2_000, 16, n_clusters=4, state=RngState(2))
     x = np.asarray(x)
     q = x[:32]
-    _, bi = brute_force_knn(x, q, 5, metric="sqeuclidean")
+    bi = np_knn_ids(x, q, 5)
     idx = mnmg_ivf_pq_build(
         comms, x,
         IVFPQParams(n_lists=4, pq_dim=4, kmeans_n_iters=6, seed=0,
@@ -261,5 +261,5 @@ def test_fewer_lists_than_ranks(comms):
     _, ids = mnmg_ivf_pq_search(
         comms, idx, q, 5, n_probes=4, refine_ratio=4.0, qcap=q.shape[0]
     )
-    r = recall(np.asarray(ids), np.asarray(bi))
+    r = recall(np.asarray(ids), bi)
     assert r > 0.9, r

@@ -17,7 +17,7 @@ from raft_tpu.random import make_blobs
 from raft_tpu.random.rng import RngState
 from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
 from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
-from raft_tpu.spatial.knn import brute_force_knn
+from tests.conftest import np_knn_ids
 
 
 def recall(got, true):
@@ -36,7 +36,7 @@ def dataset():
     ) + 0.2 * jax.random.normal(
         jax.random.fold_in(key, 1), (128, 24), jnp.float32
     )
-    _, bi = brute_force_knn(x, q, 10, metric="sqeuclidean")
+    bi = np_knn_ids(x, q, 10)
     return np.asarray(x), np.asarray(q), np.asarray(bi)
 
 
