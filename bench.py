@@ -569,8 +569,9 @@ def extra_mnmg_shard_100m_flat():
     the PQ shard row under shard_map (exact lax.top_k; the approx-top-k
     custom call loses its fast lowering there). Measured on the same
     12.5M x 96 shard/queries as the PQ row: 2.3x the QPS at HIGHER
-    recall (probe-coverage-bound 0.984 vs refinement-bound 0.9575), and
-    6.2x at the real per-chip occupancy qcap=8.
+    recall (probe-coverage ~0.9997 against the f32-exact oracle vs the
+    PQ row's refinement-bound recall — see docs/ivf_scale.md's recall
+    footnote), and ~6x at the real per-chip occupancy qcap=8.
 
     Fields mirror the PQ shard row so the two engines read side-by-side:
     ``value`` = full-load qcap-48 QPS, ``qcap8_qps`` = real-occupancy
@@ -641,7 +642,9 @@ def _mnmg_shard_100m_impl(engine: str):
         # refine_ratio=8: the r5 probe/refine sweep at this shape
         # measured recall REFINEMENT-bound, not probe-bound — p=16/24/32
         # all plateau at 0.8823 with rr=4, while rr=8 at p=16 buys
-        # recall 0.9575 for only ~5% QPS (6130 -> 5827)
+        # recall 0.9575 for only ~5% QPS (6130 -> 5827; sweep readings
+        # vs the then-bf16 oracle — the row's f32 oracle reads ~0.01
+        # higher at the same config, docs/ivf_scale.md recall footnote)
         def make_search(qcap):
             def search(qq):
                 return mnmg_ivf_pq_search(
@@ -722,9 +725,13 @@ def _mnmg_shard_100m_impl(engine: str):
     # tiny occupancy and overstate recall)
     qs = q[:1024]
     parts = [x[i * B:(i + 1) * B] for i in range(5)]
+    # oracle scores in f32 over the bf16-stored rows — the same fidelity
+    # the engines' own scoring/refinement uses. A bf16-rounded oracle
+    # (compute_dtype=bfloat16) understated flat recall by 1.6%: near-tie
+    # oracle-side rounding flips equidistant-neighbor picks, not probe
+    # misses (docs/ivf_scale.md recall footnote)
     _, true_ids = brute_force_knn(
         parts, qs, k, metric=DistanceType.L2Expanded, use_fused=True,
-        compute_dtype=jnp.bfloat16,
     )
     rec = recall_at_k(np.asarray(iv)[:1024], np.asarray(true_ids))
 

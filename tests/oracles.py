@@ -1,0 +1,26 @@
+"""Shared numpy oracles for tests (plain module, not conftest: importing
+conftest as `tests.conftest` would load it twice — once by pytest as
+top-level `conftest`, once as a package module — duplicating any
+module-level state)."""
+
+import numpy as np
+
+
+def np_knn_ids(x, q, k):
+    """Exact numpy kNN oracle (squared-L2 ids) for small test shapes.
+
+    Pure-oracle call sites (ids discarded into recall thresholds) use
+    this instead of brute_force_knn so they don't each pay a CPU-mesh
+    jit compile for their unique shape (CI wall time; brute_force_knn
+    itself is covered by tests/test_knn.py).
+    """
+    x = np.asarray(x, np.float32)
+    q = np.asarray(q, np.float32)
+    d2 = (
+        (q * q).sum(1)[:, None] + (x * x).sum(1)[None, :]
+        - 2.0 * (q @ x.T)
+    )
+    idx = np.argpartition(d2, k - 1, axis=1)[:, :k]
+    vals = np.take_along_axis(d2, idx, axis=1)
+    o = np.argsort(vals, axis=1, kind="stable")
+    return np.take_along_axis(idx, o, axis=1)

@@ -13,7 +13,7 @@ from raft_tpu.random import make_blobs
 from raft_tpu.random.rng import RngState
 from raft_tpu.spatial.ann import IVFPQParams, ivf_pq_build
 from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
-from tests.conftest import np_knn_ids
+from tests.oracles import np_knn_ids
 
 
 def recall(got, true):

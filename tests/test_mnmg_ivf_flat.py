@@ -17,7 +17,7 @@ from raft_tpu.random import make_blobs
 from raft_tpu.random.rng import RngState
 from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
 from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
-from tests.conftest import np_knn_ids
+from tests.oracles import np_knn_ids
 
 
 def recall(got, true):
