@@ -539,16 +539,18 @@ def extra_mnmg_shard_100m():
     from extrapolation to measurement:
 
     * ``value``: QPS of the shard program driving 16k queries whose
-      probes ALL land on this shard (occupancy 64 -> qcap 48) — 8x the
-      per-chip load of the real deployment, a lower bound.
+      probes ALL land on this shard (qcap="throughput"; at the cap-2048
+      builds' 8,224 local lists that resolves to 24) — 8x the per-chip
+      load of the real deployment, a lower bound.
     * ``qcap8_qps``: the same program at qcap=8 — the per-(list, query)
       occupancy the real 32768-list global probe map induces on each
       chip (mean occupancy 16384*16/32768 = 8), i.e. the realistic
       per-chip search rate in the 100M deployment.
-    * ``merge8_ms`` / ``probe32k_ms``: measured 8-way k-way merge
+    * ``merge8_ms`` / ``probe_global_ms``: measured 8-way k-way merge
       (select_k over the allgathered (8, nq, k) payloads — reference
       knn_brute_force_faiss.cuh:289-368) and measured global coarse
-      probe against all 32768 centroids.
+      probe against the deployment's full split-list centroid set
+      (8x this shard's lists — ``n_probe_cents`` on the row).
     * ``projected_100m_qps`` = nq / (qcap8 shard time + merge + global
       probe); the (nq, k) allgather itself is ~2.6 MB over ICI —
       sub-ms, folded into the merge measurement's noise floor.
@@ -574,8 +576,8 @@ def extra_mnmg_shard_100m_flat():
     footnote), and ~6x at the real per-chip occupancy qcap=8.
 
     Fields mirror the PQ shard row so the two engines read side-by-side:
-    ``value`` = full-load qcap-48 QPS, ``qcap8_qps`` = real-occupancy
-    QPS, ``merge8_ms``/``probe32k_ms`` = measured collective-phase
+    ``value`` = full-load throughput-qcap QPS, ``qcap8_qps`` = real-occupancy
+    QPS, ``merge8_ms``/``probe_global_ms`` = measured collective-phase
     costs, ``projected_100m_qps`` = nq / (qcap8 shard + merge + global
     probe). The PQ index remains the engine when codes-only compression
     is required (raw rows exceeding the mesh: higher d, fewer chips).
@@ -632,10 +634,15 @@ def _mnmg_shard_100m_impl(engine: str):
         )
         from raft_tpu.spatial.ann import IVFPQParams
 
+        # max_list_cap=2048 (vs the auto 2x-mean = 6104): same L-scaling
+        # as the flat row (selection, one-hot ADC, and the d2 buffers
+        # all carry a max_list axis) — measured 5.8k -> 11.9k full-load
+        # QPS at identical recall (0.967), qcap8 9.7k -> 15.5k (r5 cap
+        # probe at this exact config)
         idx = mnmg_ivf_pq_build_distributed(comms, xg, IVFPQParams(
             n_lists=4096, pq_dim=24, kmeans_n_iters=8,
             kmeans_init="random", train_size=1 << 20,
-            encode_block=1 << 20, store_raw=True,
+            encode_block=1 << 20, store_raw=True, max_list_cap=2048,
         ))
         float(jnp.sum(idx.codes_sorted[:, -1].astype(jnp.float32)))
 
@@ -662,8 +669,17 @@ def _mnmg_shard_100m_impl(engine: str):
         )
         from raft_tpu.spatial.ann import IVFFlatParams
 
+        # max_list_cap=2048 (vs the auto 2x-mean = 6104): selection, the
+        # (LB, qcap, L) distance buffers, and padded slab reads all scale
+        # with max_list, and the r5 cap ladder at this exact config
+        # measured 13.3k -> 32.4k -> 49.9k full-load QPS (caps
+        # 6104/3072/2048) at recall 0.9997/0.9999/0.9994, with qcap8
+        # 62.5k -> 98.8k -> 128.1k; cap=1024 over-splits (probe slots
+        # dilute across duplicate parent centroids: recall 0.9814,
+        # qcap8 95.9k). 2048 is the measured knee.
         idx = mnmg_ivf_flat_build_distributed(comms, xg, IVFFlatParams(
             n_lists=4096, kmeans_n_iters=8, kmeans_init="random",
+            max_list_cap=2048,
         ), metric="sqeuclidean")
         float(jnp.sum(idx.sorted_ids[:, -1].astype(jnp.float32)))
 
@@ -681,7 +697,10 @@ def _mnmg_shard_100m_impl(engine: str):
     build_s = time.perf_counter() - t0  # ~ per-chip share of a 100M build
     del xg  # the resharded build input (2.4 GB) — free HBM for searches
 
-    sim = make_search("throughput")                # resolves to 48 here
+    # "throughput" resolves from the split-list occupancy: 24 at the
+    # cap-2048 builds (8,224 local lists; it was 48 at the old auto-cap
+    # 4,445 — an explicit qcap=48 rerun will NOT reproduce these rows)
+    sim = make_search("throughput")
     float(jnp.sum(sim(q)[0]))
     st = chained_dispatch_stats(lambda s: q * (1.0 + 1e-6 * s), sim)
     if st is None:
@@ -709,18 +728,25 @@ def _mnmg_shard_100m_impl(engine: str):
         lambda s: dv * (1.0 + 1e-6 * s), merge8, n1=8, n2=64, escalate=1,
     )
 
-    cents32k = jax.random.normal(jax.random.fold_in(key, 5), (32768, d))
+    # global coarse-probe cost at the implied 100M deployment scale:
+    # this shard holds 1/8 of the global lists, and cap splitting
+    # multiplies the probe-set size (sublists carry their parent's
+    # centroid), so the deployment probes ~8x this shard's list count —
+    # sized from the built index, not a fixed 32768, so a cap change
+    # cannot silently leave the projection's probe term stale
+    n_gcents = -(-8 * (idx.nl_pad - 1) // 128) * 128
+    cents_g = jax.random.normal(jax.random.fold_in(key, 5), (n_gcents, d))
 
     @jax.jit
-    def probe32k(qq):
-        return coarse_probe(qq, cents32k, 16)[0]
-    float(jnp.sum(probe32k(q)))
+    def probe_g(qq):
+        return coarse_probe(qq, cents_g, 16)[0]
+    float(jnp.sum(probe_g(q)))
     stp = chained_dispatch_stats(
-        lambda s: q * (1.0 + 1e-6 * s), probe32k, n1=8, n2=64, escalate=1,
+        lambda s: q * (1.0 + 1e-6 * s), probe_g, n1=8, n2=64, escalate=1,
     )
 
     # recall vs exact oracle on a 1024-query subset, SLICED from the full
-    # 16k-query run so it reflects the timed qcap-48 configuration (a
+    # 16k-query run so it reflects the timed throughput-qcap config (a
     # subset search would re-resolve 'throughput' to qcap 8 over its own
     # tiny occupancy and overstate recall)
     qs = q[:1024]
@@ -749,7 +775,8 @@ def _mnmg_shard_100m_impl(engine: str):
     if stm is not None:
         out["merge8_ms"] = round(stm["ms"], 2)
     if stp is not None:
-        out["probe32k_ms"] = round(stp["ms"], 2)
+        out["probe_global_ms"] = round(stp["ms"], 2)
+        out["n_probe_cents"] = n_gcents
     if st8 is not None:
         out["qcap8_qps"] = round(nq / (st8["ms"] / 1e3), 1)
         if stm is not None and stp is not None:
