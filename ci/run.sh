@@ -33,6 +33,13 @@ run_install_check() {
 
 run_tests() {
     echo "== tests (virtual 8-device CPU mesh) =="
+    # Wall time ~9 min on a 1-core host: dominated by jit compile/trace
+    # of the shard_map phase programs and bf16-emulated quantizer
+    # training on the CPU mesh, not test compute (instrumented r5: the
+    # 38 s mnmg-IVF build fixture is ~10 s XLA compile + ~26 s CPU-mesh
+    # phase execution; oracle kNN compiles were moved to numpy,
+    # tests/oracles.py). Further cuts would mean fewer distinct build
+    # configs, i.e. coverage loss.
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -m pytest tests/ -q
 }
