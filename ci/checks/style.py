@@ -11,7 +11,10 @@ Checks, per Python file:
   * no tabs in indentation, no trailing whitespace
   * line length <= 100 (URLs in comments/docstrings exempt)
   * module docstring present in library code (raft_tpu/)
-  * unused imports (AST pass; names referenced in __all__ count as used)
+  * unused imports (AST pass; counts as used: names referenced in __all__
+    literals, names inside string annotations — the `if TYPE_CHECKING:`
+    import pattern under `from __future__ import annotations` — and
+    redundant-alias re-exports `from x import y as y`)
 
 Exit code 0 = clean. Run via ci/run.sh.
 """
@@ -38,18 +41,25 @@ def iter_py_files():
             yield p
 
 
-def _used_names(tree: ast.AST) -> set[str]:
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
+def _names_in(node: ast.AST, used: set[str]) -> None:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            used.add(n.id)
+        elif isinstance(n, ast.Attribute):
             # attribute roots: walk down to the base Name
-            base = node
+            base = n
             while isinstance(base, ast.Attribute):
                 base = base.value
             if isinstance(base, ast.Name):
                 used.add(base.id)
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    annotations: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            _names_in(node, used)
         elif isinstance(node, ast.Assign):
             # names listed in __all__ literals count as used (re-exports)
             for tgt in node.targets:
@@ -57,6 +67,24 @@ def _used_names(tree: ast.AST) -> set[str]:
                     for el in ast.walk(node.value):
                         if isinstance(el, ast.Constant) and isinstance(el.value, str):
                             used.add(el.value)
+        elif isinstance(node, ast.arg) and node.annotation is not None:
+            annotations.append(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.returns is not None:
+                annotations.append(node.returns)
+        elif isinstance(node, ast.AnnAssign):
+            annotations.append(node.annotation)
+    # string annotations ('List["Rule"]', PEP 563 style) reference names the
+    # plain walk cannot see — parse each string fragment as an expression
+    # and count its names, so `if TYPE_CHECKING:` imports register as used
+    for ann in annotations:
+        for el in ast.walk(ann):
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                try:
+                    frag = ast.parse(el.value, mode="eval")
+                except SyntaxError:
+                    continue
+                _names_in(frag, used)
     return used
 
 
@@ -90,6 +118,8 @@ def check_file(path: Path) -> list[str]:
         if isinstance(node, ast.Import):
             for alias in node.names:
                 name = (alias.asname or alias.name).split(".")[0]
+                if alias.asname is not None and alias.asname == alias.name:
+                    continue  # `import y as y` — explicit re-export (PEP 484)
                 if name not in used and not init:
                     problems.append(
                         f"{rel}:{node.lineno}: unused import '{alias.name}'"
@@ -99,6 +129,8 @@ def check_file(path: Path) -> list[str]:
                 continue
             for alias in node.names:
                 name = alias.asname or alias.name
+                if alias.asname is not None and alias.asname == alias.name:
+                    continue  # `from x import y as y` — explicit re-export
                 if name != "*" and name not in used and not init:
                     problems.append(
                         f"{rel}:{node.lineno}: unused import '{name}'"

@@ -17,6 +17,13 @@ stage="${1:-all}"
 run_style() {
     echo "== style =="
     python ci/checks/style.py
+    echo "== jaxlint (JAX/TPU static analysis) =="
+    # hard gate: version-sensitive JAX APIs must route through
+    # raft_tpu.compat; tracer/recompile/x64/prng hazards are lint errors.
+    # Grandfathered findings live in ci/checks/jaxlint_baseline.json.
+    JAX_PLATFORMS=cpu python -m raft_tpu.analysis \
+        --baseline ci/checks/jaxlint_baseline.json \
+        raft_tpu tests bench ci bench.py __graft_entry__.py
     if command -v ruff >/dev/null 2>&1; then
         echo "== ruff =="
         ruff check .

@@ -43,9 +43,11 @@ __all__ = [
     "get_default_resources",
     "logger",
     "errors",
+    "analysis",
     "cache",
     "cluster",
     "comms",
+    "compat",
     "distance",
     "label",
     "lap",
@@ -62,9 +64,9 @@ __all__ = [
 ]
 
 _SUBMODULES = {
-    "cache", "cluster", "comms", "core", "distance", "errors", "label", "lap",
-    "linalg", "matrix", "native", "pylibraft", "random", "sparse",
-    "spatial", "spectral", "stats", "utils",
+    "analysis", "cache", "cluster", "comms", "compat", "core", "distance",
+    "errors", "label", "lap", "linalg", "matrix", "native", "pylibraft",
+    "random", "sparse", "spatial", "spectral", "stats", "utils",
 }
 
 

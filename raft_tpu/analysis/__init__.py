@@ -1,0 +1,40 @@
+"""jaxlint — JAX/TPU-aware static analysis for raft_tpu.
+
+A multi-pass AST analyzer purpose-built for this codebase's JAX idioms
+(the reference RAFT's custom ``include_checker``-style CI checks, grown to
+cover the hazards a jit/shard_map codebase actually hits):
+
+* ``api-compat`` — version-sensitive JAX symbols used directly instead of
+  through :mod:`raft_tpu.compat` (driven by ``compat.COMPAT_TABLE``);
+* ``tracer-safety`` — host ops on traced values inside traced bodies;
+* ``recompile-hazard`` — dynamic static specs, mutable jit defaults,
+  trace-time f-strings, mutated-closure captures;
+* ``x64-hygiene`` — 64-bit dtypes crossing the jnp boundary unguarded;
+* ``prng-discipline`` — PRNG key reuse without split/fold_in.
+
+CLI: ``python -m raft_tpu.analysis [paths] [--format json] [--baseline F]
+[--write-baseline] [--rules a,b] [--list-rules]``. Per-line suppression:
+``# jaxlint: disable=<rule>[,<rule>]``. See docs/static_analysis.md.
+"""
+
+from raft_tpu.analysis.engine import (
+    Baseline,
+    Finding,
+    LintResult,
+    lint_file,
+    lint_paths,
+    lint_source,
+    main,
+)
+from raft_tpu.analysis.facts import ModuleFacts
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "LintResult",
+    "ModuleFacts",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "main",
+]

@@ -1,0 +1,13 @@
+"""Entry point: ``python -m raft_tpu.analysis [paths...]``.
+
+The ``__name__`` guard matters: tooling that walks packages (docs/gen_api)
+imports this module as ``raft_tpu.analysis.__main__``, which must not run
+the CLI.
+"""
+
+import sys
+
+from raft_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,209 @@
+"""Pass 1 of the analyzer: per-module fact gathering.
+
+Rules never re-derive module structure themselves; this pass walks the AST
+once and exposes:
+
+* import alias resolution (``import jax.numpy as jnp`` → ``jnp`` means
+  ``jax.numpy``; ``from jax import lax`` → ``lax`` means ``jax.lax``), so
+  rules match *dotted origin paths*, not surface spellings;
+* the set of function bodies that execute under a JAX trace (decorated with
+  ``jit``-family transforms, or passed as the callable to ``jit`` /
+  ``shard_map`` / ``vmap`` / ``lax.scan`` / ... calls), including lambdas;
+* per traced function, which parameters are declared static
+  (``static_argnums`` / ``static_argnames``) and therefore safe to branch on;
+* a parent map for ancestor queries.
+
+This is deliberately lexical, not a type system: a method invoked *from* a
+traced region in another module is not seen. The rules it feeds are linters
+— suppressions and the baseline absorb the residue.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+# Transforms whose callable argument runs under a tracer. Matched against
+# the LAST segment of the resolved dotted callee (``jax.jit``, ``lax.scan``,
+# ``comms.shard_map`` and the compat spelling all normalize to their tail).
+TRACING_TRANSFORMS = frozenset({
+    "jit", "pjit", "shard_map", "pmap", "vmap", "xmap",
+    "grad", "value_and_grad", "jacfwd", "jacrev", "hessian",
+    "remat", "checkpoint", "custom_jvp", "custom_vjp",
+    "scan", "while_loop", "fori_loop", "cond", "switch",
+    "associative_scan", "map",
+})
+
+# tails that collide with Python builtins: only a jax-rooted dotted path
+# (lax.map -> "jax.lax.map") counts — the builtin `map(f, xs)` must not
+# mark `f` as traced
+_AMBIGUOUS_TAILS = frozenset({"map"})
+
+
+def dotted_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` Attribute/Name chain as ["a","b","c"], or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class ModuleFacts:
+    """Everything pass-1 knows about one parsed module."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        # local name -> dotted origin ("jnp" -> "jax.numpy")
+        self.aliases: Dict[str, str] = {}
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        self.functions_by_name: Dict[str, List[ast.FunctionDef]] = {}
+        self.traced: List[FunctionNode] = []
+        self.static_params: Dict[FunctionNode, Set[str]] = {}
+        self._collect()
+
+    # -- name resolution -----------------------------------------------------
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolve an Attribute/Name chain to its dotted origin path,
+        expanding import aliases on the root segment."""
+        chain = dotted_chain(node)
+        if not chain:
+            return None
+        root = self.aliases.get(chain[0], chain[0])
+        return ".".join([root] + chain[1:])
+
+    def callee(self, call: ast.Call) -> Optional[str]:
+        return self.dotted(call.func)
+
+    def aliases_of(self, dotted_prefix: str) -> Set[str]:
+        """Local names whose origin is exactly ``dotted_prefix``."""
+        return {
+            local for local, origin in self.aliases.items()
+            if origin == dotted_prefix
+        }
+
+    # -- collection ----------------------------------------------------------
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.aliases[alias.asname] = alias.name
+                    else:
+                        # `import jax.numpy` binds only the root name `jax`
+                        root = alias.name.split(".")[0]
+                        self.aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                if node.level or not node.module:
+                    continue  # relative imports stay local-package
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.aliases[local] = f"{node.module}.{alias.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions_by_name.setdefault(node.name, []).append(node)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_decorators(node)
+            elif isinstance(node, ast.Call):
+                self._check_transform_call(node)
+
+    def _mark_traced(self, fn: FunctionNode, static: Set[str]) -> None:
+        if fn not in self.traced:
+            self.traced.append(fn)
+        self.static_params.setdefault(fn, set()).update(static)
+
+    def _param_names(self, fn: FunctionNode) -> List[str]:
+        a = fn.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def _static_from_call(self, call: ast.Call, fn: FunctionNode) -> Set[str]:
+        """Parameter names declared static via static_argnums/argnames."""
+        names = self._param_names(fn)
+        static: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        static.add(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        if 0 <= el.value < len(names):
+                            static.add(names[el.value])
+        return static
+
+    def _transform_tail(self, node: ast.AST) -> Optional[str]:
+        d = self.dotted(node)
+        if d is None:
+            return None
+        tail = d.split(".")[-1]
+        if tail not in TRACING_TRANSFORMS:
+            return None
+        if tail in _AMBIGUOUS_TAILS and not d.startswith("jax."):
+            return None
+        return tail
+
+    def _check_decorators(self, fn: ast.FunctionDef) -> None:
+        for dec in fn.decorator_list:
+            call = dec if isinstance(dec, ast.Call) else None
+            target = call.func if call else dec
+            tail = self._transform_tail(target)
+            if tail is None and call is not None:
+                # @partial(jax.jit, static_argnums=...)
+                ct = self.dotted(call.func)
+                if ct and ct.split(".")[-1] == "partial" and call.args:
+                    inner_tail = self._transform_tail(call.args[0])
+                    if inner_tail:
+                        self._mark_traced(fn, self._static_from_call(call, fn))
+                        continue
+            if tail is not None:
+                static = self._static_from_call(call, fn) if call else set()
+                self._mark_traced(fn, static)
+
+    def _check_transform_call(self, call: ast.Call) -> None:
+        tail = self._transform_tail(call.func)
+        is_partial_jit = False
+        if tail is None:
+            ct = self.dotted(call.func)
+            if ct and ct.split(".")[-1] == "partial" and call.args:
+                if self._transform_tail(call.args[0]):
+                    is_partial_jit = True
+        if tail is None and not is_partial_jit:
+            return
+        args = call.args[1:] if is_partial_jit else call.args
+        static: Set[str] = set()
+        for arg in args:
+            fn: Optional[FunctionNode] = None
+            if isinstance(arg, ast.Lambda):
+                fn = arg
+            elif isinstance(arg, ast.Name):
+                defs = self.functions_by_name.get(arg.id)
+                if defs:
+                    fn = defs[-1]
+            if fn is not None:
+                static = self._static_from_call(call, fn)
+                self._mark_traced(fn, static)
+
+    # -- traced-body queries -------------------------------------------------
+
+    def traced_body_nodes(self, fn: FunctionNode):
+        """All AST nodes inside a traced callable's body (including nested
+        defs — they trace too when the outer one does)."""
+        bodies = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in bodies:
+            yield from ast.walk(stmt)
+
+    def nonstatic_params(self, fn: FunctionNode) -> Set[str]:
+        return set(self._param_names(fn)) - self.static_params.get(fn, set())

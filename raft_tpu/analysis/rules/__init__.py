@@ -1,0 +1,41 @@
+"""Rule registry. Each rule module exposes RULES; this package aggregates
+them into ALL_RULES in documentation order."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator, List
+
+if TYPE_CHECKING:
+    from raft_tpu.analysis.engine import FileContext, Finding
+
+
+class Rule:
+    """One named check. Subclasses set ``name``/``description`` and yield
+    :class:`~raft_tpu.analysis.engine.Finding` s from :meth:`check`."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator["Finding"]:
+        raise NotImplementedError
+
+
+def _collect() -> List[Rule]:
+    from raft_tpu.analysis.rules import (
+        api_compat,
+        prng_discipline,
+        recompile_hazard,
+        tracer_safety,
+        x64_hygiene,
+    )
+
+    out: List[Rule] = []
+    for mod in (api_compat, tracer_safety, recompile_hazard,
+                x64_hygiene, prng_discipline):
+        out.extend(mod.RULES)
+    return out
+
+
+ALL_RULES: List[Rule] = _collect()
+
+__all__ = ["Rule", "ALL_RULES"]

@@ -1,0 +1,88 @@
+"""Rule ``api-compat``: version-sensitive JAX symbols must come from
+``raft_tpu.compat``.
+
+The banned spellings are read from :data:`raft_tpu.compat.COMPAT_TABLE` —
+the same table the runtime shim resolves against — so the linter and the
+shim can never drift apart. Both the attribute form (``jax.shard_map(...)``)
+and the import form (``from jax.experimental.shard_map import shard_map``)
+are flagged. ``raft_tpu/compat.py`` itself resolves symbols by dotted-path
+*string*, so it never trips its own rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator
+
+from raft_tpu import compat
+from raft_tpu.analysis.facts import dotted_chain
+from raft_tpu.analysis.rules import Rule
+
+
+def _banned_map() -> Dict[str, "compat.CompatEntry"]:
+    out: Dict[str, compat.CompatEntry] = {}
+    for entry in compat.COMPAT_TABLE:
+        for spelling in entry.banned:
+            out[spelling] = entry
+    return out
+
+
+class ApiCompatRule(Rule):
+    name = "api-compat"
+    description = (
+        "direct use of a version-sensitive JAX symbol; import it from "
+        "raft_tpu.compat instead"
+    )
+
+    def __init__(self):
+        self.banned = _banned_map()
+
+    def _msg(self, spelling: str, entry: "compat.CompatEntry") -> str:
+        return (
+            f"direct use of '{spelling}' — use "
+            f"raft_tpu.compat.{entry.name} ({entry.reason})"
+        )
+
+    def check(self, ctx) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                chain = dotted_chain(node)
+                if not chain:
+                    continue
+                # resolve the root through import aliases so
+                # `import jax as j; j.shard_map` is still caught
+                root = ctx.facts.aliases.get(chain[0], chain[0])
+                dotted = ".".join([root] + chain[1:])
+                entry = self.banned.get(dotted)
+                # only flag the OUTERMOST matching attribute: for
+                # a.b.c both `a.b.c` and `a.b` walk by; the parent check
+                # keeps one finding per use
+                if entry is not None and not isinstance(
+                    ctx.facts.parent.get(node), ast.Attribute
+                ):
+                    yield ctx.finding(
+                        self.name, node, self._msg(dotted, entry)
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                if not node.module or node.level:
+                    continue
+                for alias in node.names:
+                    dotted = f"{node.module}.{alias.name}"
+                    entry = self.banned.get(dotted) \
+                        or self.banned.get(node.module)
+                    if entry is not None:
+                        spelling = dotted if dotted in self.banned \
+                            else node.module
+                        yield ctx.finding(
+                            self.name, node, self._msg(spelling, entry)
+                        )
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    entry = self.banned.get(alias.name)
+                    if entry is not None:
+                        yield ctx.finding(
+                            self.name, node, self._msg(alias.name, entry)
+                        )
+
+
+RULES = [ApiCompatRule()]

@@ -1,0 +1,151 @@
+"""Rule ``prng-discipline``: a PRNG key consumed by two sampling calls
+without an intervening ``split``/``fold_in`` produces *identical* streams —
+statistically catastrophic and invisible to tests that only check shapes.
+
+Tracking is per-function and flow-insensitive-but-ordered: a name becomes a
+live key when assigned from ``PRNGKey``/``key``/``fold_in``/``split``; a
+direct ``jax.random.<dist>`` call consumes it (passing it to ``split`` /
+``fold_in`` derives, never consumes; any reassignment refreshes). The
+second consumption of the same live key is flagged. Keys handed to helper
+functions are not tracked across the call boundary — this is a linter, not
+an escape analysis; the common bug (two ``jax.random.normal(key, ...)``
+draws in one body) is exactly what it catches.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Union
+
+from raft_tpu.analysis.rules import Rule
+
+_KEY_MAKERS = {"PRNGKey", "key", "fold_in", "split", "wrap_key_data"}
+_NON_CONSUMING = {"split", "fold_in", "PRNGKey", "key", "wrap_key_data",
+                  "key_data", "clone"}
+_RANDOM_NS = "jax.random"
+
+
+def _walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk like ast.walk but do not descend into nested function scopes —
+    a nested def runs at its own (unknown) time, so its draws cannot be
+    ordered against this scope's; each nested def is scanned separately."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield from _walk_same_scope(child)
+
+
+class PrngDisciplineRule(Rule):
+    name = "prng-discipline"
+    description = "PRNG key reused by multiple draws without split/fold_in"
+
+    def _random_tail(self, ctx, call: ast.Call) -> Union[str, None]:
+        """'normal' for jax.random.normal(...), None for non-random calls."""
+        d = ctx.facts.dotted(call.func)
+        if d is None:
+            return None
+        if d.startswith(_RANDOM_NS + "."):
+            return d[len(_RANDOM_NS) + 1:]
+        return None
+
+    def _scan_body(self, ctx, body: List[ast.stmt]) -> Iterator:
+        # name -> "live" (fresh key) | "consumed"
+        state: Dict[str, str] = {}
+        yield from self._scan_stmts(ctx, body, state)
+
+    def _scan_stmts(self, ctx, stmts: List[ast.stmt],
+                    state: Dict[str, str]) -> Iterator:
+        """Statement-list scan with branch awareness: if/else arms execute
+        mutually exclusively, so each scans a fork of the state; the merge
+        keeps 'consumed' from either arm (a draw in one arm still blocks a
+        later unconditional draw) but never counts the arms against each
+        other. Loop/with/try bodies share the sequential state."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                yield from self._scan_flat(ctx, stmt.test, state)
+                s_else = dict(state)
+                yield from self._scan_stmts(ctx, stmt.body, state)
+                yield from self._scan_stmts(ctx, stmt.orelse, s_else)
+                for k in set(state) | set(s_else):
+                    vals = {state.get(k), s_else.get(k)}
+                    if "consumed" in vals:
+                        state[k] = "consumed"
+                    elif "live" in vals:
+                        state[k] = "live"
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from self._scan_flat(ctx, stmt.iter, state)
+                yield from self._scan_stmts(ctx, stmt.body, state)
+                yield from self._scan_stmts(ctx, stmt.orelse, state)
+            elif isinstance(stmt, ast.While):
+                yield from self._scan_flat(ctx, stmt.test, state)
+                yield from self._scan_stmts(ctx, stmt.body, state)
+                yield from self._scan_stmts(ctx, stmt.orelse, state)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    yield from self._scan_flat(
+                        ctx, item.context_expr, state)
+                yield from self._scan_stmts(ctx, stmt.body, state)
+            elif isinstance(stmt, ast.Try):
+                yield from self._scan_stmts(ctx, stmt.body, state)
+                for handler in stmt.handlers:
+                    yield from self._scan_stmts(ctx, handler.body, state)
+                yield from self._scan_stmts(ctx, stmt.orelse, state)
+                yield from self._scan_stmts(ctx, stmt.finalbody, state)
+            else:
+                yield from self._scan_flat(ctx, stmt, state)
+
+    def _scan_flat(self, ctx, node: ast.AST,
+                   state: Dict[str, str]) -> Iterator:
+        """Consumptions then assignments within one flat statement/expr."""
+        for n in _walk_same_scope(node):
+            if isinstance(n, ast.Call):
+                tail = self._random_tail(ctx, n)
+                if tail is None or tail in _NON_CONSUMING or "." in tail:
+                    continue
+                used = [
+                    a for a in list(n.args) + [k.value for k in n.keywords]
+                    if isinstance(a, ast.Name) and a.id in state
+                ]
+                for name_node in used:
+                    if state[name_node.id] == "consumed":
+                        yield ctx.finding(
+                            self.name, n,
+                            f"key '{name_node.id}' already consumed by "
+                            f"an earlier draw — jax.random.{tail} will "
+                            "replay the same stream; split or fold_in "
+                            "first",
+                        )
+                    else:
+                        state[name_node.id] = "consumed"
+        # assignments refresh liveness AFTER uses in the same stmt
+        for n in _walk_same_scope(node):
+            if isinstance(n, ast.Assign):
+                value_is_key = (
+                    isinstance(n.value, ast.Call)
+                    and self._is_key_maker(ctx, n.value)
+                )
+                for tgt in n.targets:
+                    for t in ast.walk(tgt):
+                        if isinstance(t, ast.Name):
+                            if value_is_key:
+                                state[t.id] = "live"
+                            else:
+                                state.pop(t.id, None)
+
+    def _is_key_maker(self, ctx, call: ast.Call) -> bool:
+        d = ctx.facts.dotted(call.func)
+        if d is None:
+            # obj.key() style (RngState.key) counts as a maker
+            return isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _KEY_MAKERS
+        return d.split(".")[-1] in _KEY_MAKERS
+
+    def check(self, ctx) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan_body(ctx, node.body)
+
+
+RULES = [PrngDisciplineRule()]

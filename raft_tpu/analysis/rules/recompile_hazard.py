@@ -1,0 +1,133 @@
+"""Rule ``recompile-hazard``: patterns that defeat jit's compile cache.
+
+Every cache miss on the serving path is a multi-second XLA compile under
+traffic, so these are production hazards, not style nits:
+
+* ``static_argnums``/``static_argnames`` given as a *dynamic expression* —
+  the static spec itself must be a literal, or every call site silently
+  traces its own variant;
+* mutable (list/dict/set) default parameter on a jit-traced function —
+  unhashable as a static and a retrace per call when captured;
+* f-strings inside a traced body — host string formatting re-runs at every
+  trace; the classic offender builds cache keys / debug labels from traced
+  values, which forces the recompile it tried to observe;
+* closure over a variable the enclosing function mutates with ``+=``-style
+  augmented assignment — its value varies per call, so each call traces a
+  new constant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_tpu.analysis.rules import Rule
+
+_JIT_TAILS = {"jit", "pjit"}
+
+
+def _is_literal_spec(node: ast.AST) -> bool:
+    """Constant, or tuple/list of constants (incl. unary minus)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _is_literal_spec(node.operand)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal_spec(e) for e in node.elts)
+    return False
+
+
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    description = "pattern that forces avoidable jit recompiles"
+
+    def _check_jit_call(self, ctx, call: ast.Call) -> Iterator:
+        d = ctx.facts.dotted(call.func)
+        if d is None or d.split(".")[-1] not in _JIT_TAILS:
+            return
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames") and \
+                    not _is_literal_spec(kw.value):
+                yield ctx.finding(
+                    self.name, kw.value,
+                    f"{kw.arg} is a dynamic expression — the static spec "
+                    "must be a literal or every call site compiles its own "
+                    "variant",
+                )
+
+    def _check_defaults(self, ctx, fn) -> Iterator:
+        if isinstance(fn, ast.Lambda):
+            return
+        for default in fn.args.defaults + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                yield ctx.finding(
+                    self.name, default,
+                    f"mutable default on jit-traced '{fn.name}' — "
+                    "unhashable as a static argument and retraces when "
+                    "its identity changes",
+                )
+
+    def _check_closure_mutation(self, ctx, fn) -> Iterator:
+        """Traced nested function reading a name its enclosing scope
+        mutates via augmented assignment."""
+        enclosing = ctx.facts.parent.get(fn)
+        while enclosing is not None and not isinstance(
+                enclosing, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            enclosing = ctx.facts.parent.get(enclosing)
+        if enclosing is None:
+            return
+        mutated = set()
+        for n in ast.walk(enclosing):
+            if isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Name):
+                # ignore mutations inside the traced fn itself
+                inside = n
+                while inside is not None and inside is not fn:
+                    inside = ctx.facts.parent.get(inside)
+                if inside is None:
+                    mutated.add(n.target.id)
+        if not mutated:
+            return
+        local = set()
+        for n in ctx.facts.traced_body_nodes(fn):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                tgt = n.targets[0] if isinstance(n, ast.Assign) else n.target
+                if isinstance(tgt, ast.Name):
+                    local.add(tgt.id)
+        params = set()
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            params.add(p.arg)
+        seen = set()
+        for n in ctx.facts.traced_body_nodes(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and \
+                    n.id in mutated and n.id not in params and \
+                    n.id not in local and n.id not in seen:
+                seen.add(n.id)
+                yield ctx.finding(
+                    self.name, n,
+                    f"traced closure reads '{n.id}', which the enclosing "
+                    "function mutates — its value varies per call, so each "
+                    "call traces a fresh constant (recompile)",
+                )
+
+    def check(self, ctx) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_jit_call(ctx, node)
+        for fn in ctx.facts.traced:
+            yield from self._check_defaults(ctx, fn)
+            yield from self._check_closure_mutation(ctx, fn)
+            for node in ctx.facts.traced_body_nodes(fn):
+                if isinstance(node, ast.JoinedStr):
+                    yield ctx.finding(
+                        self.name, node,
+                        "f-string inside a traced body — host formatting "
+                        "re-runs per trace; if it feeds a cache key or "
+                        "label from traced values it forces recompiles",
+                    )
+
+
+RULES = [RecompileHazardRule()]

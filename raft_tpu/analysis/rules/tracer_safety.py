@@ -1,0 +1,127 @@
+"""Rule ``tracer-safety``: host-side operations on traced values inside
+``jit``/``shard_map``/``vmap``/``lax.scan``-family bodies.
+
+Each of these either crashes at trace time (``TracerArrayConversionError``,
+``ConcretizationTypeError``) or silently constant-folds a traced value —
+the production failure mode the ROADMAP's serving story cannot afford:
+
+* ``np.asarray`` / ``np.array`` / ``np.ascontiguousarray`` on anything —
+  numpy materializes, which forces a device sync or trace error;
+* ``float()`` / ``int()`` / ``bool()`` on a traced parameter or on a
+  ``jnp``/``lax`` expression — concretization;
+* ``.item()`` — device sync + concretization;
+* Python ``if``/``while`` whose condition reads a non-static traced
+  parameter directly (``x.shape``/``.ndim``/``.dtype``/``.size`` access is
+  static metadata and exempt; parameters declared in ``static_argnums`` /
+  ``static_argnames`` are exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from raft_tpu.analysis.rules import Rule
+
+_NUMPY_MATERIALIZERS = {"asarray", "array", "ascontiguousarray"}
+_COERCIONS = {"float", "int", "bool", "complex"}
+_STATIC_METADATA = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_TRACED_ROOTS = {"jax.numpy", "jax.lax", "jax"}
+
+
+class TracerSafetyRule(Rule):
+    name = "tracer-safety"
+    description = (
+        "host-side op on a traced value inside a jit/shard_map/vmap body"
+    )
+
+    def _is_jax_expr(self, ctx, node: ast.AST) -> bool:
+        """A call rooted in jax/jnp/lax — its result is a traced array."""
+        if not isinstance(node, ast.Call):
+            return False
+        d = ctx.facts.dotted(node.func)
+        if d is None:
+            return False
+        return any(d == r or d.startswith(r + ".") for r in _TRACED_ROOTS)
+
+    def _control_flow_hits(self, ctx, test: ast.AST,
+                           params: Set[str]) -> Iterator[ast.Name]:
+        """Non-static traced params read *as values* in a condition.
+
+        Host-side structural checks are exempt: ``x is None``,
+        ``isinstance(x, T)``/``hasattr``/``callable``, and any attribute
+        access (``x.shape``, ``index.metric`` — array metadata and pytree
+        static fields, not traced values)."""
+        parents = ctx.facts.parent
+        for n in ast.walk(test):
+            if not (isinstance(n, ast.Name) and n.id in params):
+                continue
+            exempt = False
+            cur = n
+            while cur is not None and cur is not test and not exempt:
+                p = parents.get(cur)
+                if isinstance(p, ast.Attribute):
+                    exempt = True  # branching on metadata/static field
+                elif isinstance(p, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops
+                ):
+                    exempt = True  # identity check (x is None) is host-side
+                elif isinstance(p, ast.Call):
+                    d = ctx.facts.dotted(p.func)
+                    if d in ("isinstance", "hasattr", "callable", "len",
+                             "type"):
+                        exempt = True
+                cur = p
+            if not exempt:
+                yield n
+
+    def check(self, ctx) -> Iterator:
+        for fn in ctx.facts.traced:
+            params = ctx.facts.nonstatic_params(fn)
+            for node in ctx.facts.traced_body_nodes(fn):
+                if isinstance(node, ast.Call):
+                    d = ctx.facts.dotted(node.func)
+                    if d is not None:
+                        parts = d.split(".")
+                        root = ".".join(parts[:-1])
+                        if parts[-1] in _NUMPY_MATERIALIZERS and \
+                                root == "numpy":
+                            yield ctx.finding(
+                                self.name, node,
+                                f"numpy.{parts[-1]}() inside a traced body "
+                                "materializes on host (trace error or "
+                                "silent constant-fold); use jnp",
+                            )
+                            continue
+                        if d in _COERCIONS and len(node.args) == 1:
+                            arg = node.args[0]
+                            traced_arg = (
+                                isinstance(arg, ast.Name)
+                                and arg.id in params
+                            ) or self._is_jax_expr(ctx, arg)
+                            if traced_arg:
+                                yield ctx.finding(
+                                    self.name, node,
+                                    f"{d}() coercion of a traced value "
+                                    "concretizes at trace time",
+                                )
+                                continue
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "item" and not node.args:
+                        yield ctx.finding(
+                            self.name, node,
+                            ".item() inside a traced body forces a device "
+                            "sync and concretizes",
+                        )
+                elif isinstance(node, (ast.If, ast.While)):
+                    for hit in self._control_flow_hits(
+                            ctx, node.test, params):
+                        yield ctx.finding(
+                            self.name, hit,
+                            f"Python {'if' if isinstance(node, ast.If) else 'while'} "
+                            f"on traced parameter '{hit.id}' — use lax.cond/"
+                            "lax.while_loop or declare it static",
+                        )
+
+
+RULES = [TracerSafetyRule()]

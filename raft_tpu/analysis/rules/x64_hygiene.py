@@ -1,0 +1,124 @@
+"""Rule ``x64-hygiene``: literals/dtypes that silently change width under
+``jax_enable_x64``.
+
+Complements the runtime pass in ``tests/x64_checks.py`` (which runs the
+suite under x64 in its own process): the lint side catches the authoring
+mistakes before they need a second process to reproduce:
+
+* ``jnp.float64`` / ``jnp.int64`` / ``jnp.complex128`` references — under
+  default config these silently canonicalize to 32-bit, under x64 they
+  double memory/bandwidth; a use *guarded* by an explicit
+  ``jax_enable_x64`` config read on the same line is exempt (that is the
+  sanctioned pattern);
+* 64-bit dtypes handed to ``jnp.*`` calls via ``dtype=`` — whether spelled
+  ``np.float64``, ``"float64"``, or the Python builtin ``float``/``int``
+  (which mean f64/i64 to numpy and change meaning with x64).
+
+A module that *enables* x64 at top level (``jax.config.update(
+"jax_enable_x64", True)`` or setting the env var before importing jax —
+the tests/x64_checks.py harness pattern) has opted into 64-bit semantics
+process-wide and is exempt wholesale.
+
+Host-side ``np.float64`` arrays (dendrogram bookkeeping, ctypes buffers)
+are intentionally NOT flagged — numpy is allowed to be 64-bit on host;
+only values entering the jnp boundary are checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_tpu.analysis.rules import Rule
+
+_WIDE = {"float64", "int64", "uint64", "complex128"}
+_BUILTIN_WIDE = {"float", "int", "complex"}
+_GUARD = "jax_enable_x64"
+
+
+class X64HygieneRule(Rule):
+    name = "x64-hygiene"
+    description = "64-bit literal/dtype that shifts meaning under x64"
+
+    def _guarded(self, ctx, node: ast.AST) -> bool:
+        line = getattr(node, "lineno", 0)
+        if 1 <= line <= len(ctx.lines):
+            return _GUARD in ctx.lines[line - 1]
+        return False
+
+    def _module_enables_x64(self, ctx) -> bool:
+        """True for modules that switch x64 ON at import (x64 harnesses).
+
+        Only an actual enable counts: `jax.config.update("jax_enable_x64",
+        True)` or a truthy `os.environ["JAX_ENABLE_X64"] = ...` store.
+        Setting it falsy (or touching an unrelated dict with that key)
+        must NOT silence the rule."""
+        for stmt in ctx.tree.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    d = ctx.facts.dotted(node.func)
+                    if d and d.endswith("config.update") and node.args and \
+                            isinstance(node.args[0], ast.Constant) and \
+                            node.args[0].value == _GUARD and \
+                            len(node.args) > 1 and \
+                            isinstance(node.args[1], ast.Constant) and \
+                            node.args[1].value is True:
+                        return True
+                elif isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Constant) and \
+                        node.value.value not in ("0", "", "false", "False",
+                                                 0, False, None):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript) and \
+                                isinstance(tgt.slice, ast.Constant) and \
+                                tgt.slice.value == "JAX_ENABLE_X64" and \
+                                ctx.facts.dotted(tgt.value) == "os.environ":
+                            return True
+        return False
+
+    def _is_jnp_rooted(self, ctx, call: ast.Call) -> bool:
+        d = ctx.facts.dotted(call.func)
+        return d is not None and (
+            d.startswith("jax.numpy.") or d.startswith("jax.lax.")
+        )
+
+    def check(self, ctx) -> Iterator:
+        if self._module_enables_x64(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr in _WIDE:
+                d = ctx.facts.dotted(node)
+                if d and d.startswith("jax.numpy.") and \
+                        not self._guarded(ctx, node):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"jnp.{node.attr} canonicalizes to 32-bit without "
+                        "x64 and doubles width with it — guard with an "
+                        "explicit jax_enable_x64 read or pick the width",
+                    )
+            elif isinstance(node, ast.Call) and \
+                    self._is_jnp_rooted(ctx, node):
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    v = kw.value
+                    bad = None
+                    if isinstance(v, ast.Attribute) and v.attr in _WIDE:
+                        vd = ctx.facts.dotted(v)
+                        if vd is not None and vd.startswith("jax.numpy."):
+                            continue  # the attribute check already flags it
+                        bad = v.attr
+                    elif isinstance(v, ast.Constant) and v.value in _WIDE:
+                        bad = v.value
+                    elif isinstance(v, ast.Name) and v.id in _BUILTIN_WIDE \
+                            and v.id not in ctx.facts.aliases:
+                        bad = f"builtin {v.id} (means 64-bit)"
+                    if bad is not None and not self._guarded(ctx, v):
+                        yield ctx.finding(
+                            self.name, v,
+                            f"dtype={bad} at the jnp boundary silently "
+                            "upcasts under jax_enable_x64",
+                        )
+
+
+RULES = [X64HygieneRule()]

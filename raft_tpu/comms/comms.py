@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from raft_tpu import errors
+from raft_tpu import compat, errors
 
 __all__ = [
     "ReduceOp", "AxisComms", "P2PBatch", "Comms", "HierarchicalComms",
@@ -71,7 +71,7 @@ class AxisComms:
 
     # -- topology ------------------------------------------------------------
     def get_size(self) -> int:
-        return lax.axis_size(self.axis)
+        return compat.axis_size(self.axis)
 
     def get_rank(self):
         return lax.axis_index(self.axis)
@@ -373,8 +373,11 @@ class Comms:
         with rank-varying collective results (scan carries, merge loops);
         the varying-manual-axes inference rejects those mixes even when
         semantically fine, exactly like a rank-symmetric NCCL program.
+
+        Goes through :mod:`raft_tpu.compat` — ``shard_map``'s home and its
+        check kwarg's name both moved across JAX releases.
         """
-        return jax.shard_map(
+        return compat.shard_map(
             fn,
             mesh=self.mesh,
             in_specs=in_specs,

@@ -42,7 +42,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from raft_tpu import errors
+from raft_tpu import compat, errors
 from raft_tpu.comms.comms import Comms
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.spatial.ann.common import (
@@ -66,7 +66,7 @@ __all__ = [
 ]
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class MnmgIVFPQIndex:
     """List-sharded IVF-PQ index over a comms mesh.

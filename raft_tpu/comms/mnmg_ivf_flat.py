@@ -41,7 +41,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu import errors
+from raft_tpu import compat, errors
 from raft_tpu.cluster.kmeans import kmeans_predict
 from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.mnmg_ivf import (
@@ -71,7 +71,7 @@ __all__ = [
 ]
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class MnmgIVFFlatIndex:
     """List-sharded IVF-Flat index over a comms mesh (the exact-scoring

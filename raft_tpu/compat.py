@@ -1,0 +1,243 @@
+"""JAX version-compatibility shim — the single sanctioned access point for
+version-sensitive JAX APIs.
+
+JAX moves symbols between releases (``jax.experimental.shard_map.shard_map``
+graduated to ``jax.shard_map``; ``jax.tree_map`` was removed in favour of
+``jax.tree.map``; ``shard_map``'s replication-check kwarg was renamed
+``check_rep`` → ``check_vma``). Direct use of any spelling pins the codebase
+to one JAX release and is exactly the hazard that broke the seed suite
+(``jax.shard_map`` does not exist on JAX 0.4.x). This module resolves each
+symbol against the installed JAX at import time, from a declarative
+:data:`COMPAT_TABLE` that the static analyzer (``raft_tpu.analysis``, rule
+``api-compat``) consumes to flag direct spellings at lint time. The analog
+in the reference RAFT is the pinned-RAPIDS-version dependency wall; here the
+wall is one table.
+
+Policy (enforced by ``python -m raft_tpu.analysis``):
+
+* library code imports version-sensitive symbols from ``raft_tpu.compat``,
+  never from their ``jax...`` home directly;
+* adding a new version-sensitive symbol means adding a ``CompatEntry`` (the
+  linter picks it up automatically from the table's ``banned`` spellings).
+
+Resolution is by dotted-path string (``importlib`` + ``getattr``), so this
+module itself never spells a banned attribute access in AST form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import inspect
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "COMPAT_TABLE",
+    "CompatEntry",
+    "jax_version",
+    "resolve",
+    "shard_map",
+    "axis_size",
+    "tree_map",
+    "register_dataclass",
+    "pure_callback",
+    "io_callback",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CompatEntry:
+    """One version-sensitive symbol: how to find it, and how not to spell it.
+
+    ``candidates`` are dotted paths tried in order against the installed JAX
+    (first hit wins). ``banned`` are the dotted spellings the ``api-compat``
+    lint rule flags in library code — every candidate plus removed aliases.
+    """
+
+    name: str                      # attribute exposed on raft_tpu.compat
+    candidates: Tuple[str, ...]    # dotted paths, newest spelling first
+    banned: Tuple[str, ...]        # spellings jaxlint flags at call sites
+    reason: str                    # one-line rationale shown in lint output
+
+
+COMPAT_TABLE: Tuple[CompatEntry, ...] = (
+    CompatEntry(
+        name="shard_map",
+        candidates=(
+            "jax.shard_map",
+            "jax.experimental.shard_map.shard_map",
+        ),
+        banned=(
+            "jax.shard_map",
+            "jax.experimental.shard_map.shard_map",
+            "jax.experimental.shard_map",
+        ),
+        reason="graduated from jax.experimental.shard_map in JAX 0.6; the "
+               "replication-check kwarg is check_rep on 0.4/0.5 and "
+               "check_vma on 0.6+ — compat.shard_map accepts either",
+    ),
+    CompatEntry(
+        name="axis_size",
+        candidates=(
+            "jax.lax.axis_size",
+            "jax.core.axis_frame",   # 0.4.x: returns the static size directly
+        ),
+        banned=(
+            "jax.lax.axis_size",
+        ),
+        reason="lax.axis_size only exists on newer JAX; 0.4.x exposes the "
+               "static mesh-axis size via jax.core.axis_frame",
+    ),
+    CompatEntry(
+        name="tree_map",
+        candidates=(
+            "jax.tree.map",
+            "jax.tree_util.tree_map",
+        ),
+        banned=(
+            "jax.tree_map",
+            "jax.tree_multimap",
+        ),
+        reason="jax.tree_map was deprecated in 0.4.25 and removed in 0.6",
+    ),
+    CompatEntry(
+        name="register_dataclass",
+        candidates=(
+            "jax.tree_util.register_dataclass",
+        ),
+        banned=(
+            "jax.tree_util.register_dataclass",
+        ),
+        reason="added in JAX 0.4.26 and its signature is still evolving "
+               "(drop_fields, auto field inference); route through compat "
+               "so a shim has one place to land",
+    ),
+    CompatEntry(
+        name="pure_callback",
+        candidates=(
+            "jax.pure_callback",
+            "jax.experimental.pure_callback",
+        ),
+        banned=(
+            "jax.experimental.pure_callback",
+        ),
+        reason="graduated from jax.experimental in 0.4.27; the experimental "
+               "alias is removed in newer releases",
+    ),
+    CompatEntry(
+        name="io_callback",
+        candidates=(
+            # forward candidate: resolution is eager at import, so the
+            # anticipated graduation must already be in the list or the
+            # whole library stops importing on that future JAX
+            "jax.io_callback",
+            "jax.experimental.io_callback",
+        ),
+        banned=(
+            "jax.experimental.io_callback",
+        ),
+        reason="still experimental — isolate the spelling here so its "
+               "eventual graduation is a one-line table edit",
+    ),
+)
+
+
+def jax_version() -> Tuple[int, ...]:
+    """Installed JAX version as a comparable int tuple (e.g. (0, 4, 37))."""
+    parts = []
+    for p in jax.__version__.split("."):
+        digits = "".join(ch for ch in p if ch.isdigit())
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts)
+
+
+def _lookup(dotted: str) -> Any:
+    """Resolve a dotted path against installed modules, or raise
+    AttributeError/ImportError. Tries the longest importable module prefix,
+    then getattrs down the remainder."""
+    parts = dotted.split(".")
+    obj: Any = None
+    err: Optional[Exception] = None
+    for split in range(len(parts), 0, -1):
+        mod_name = ".".join(parts[:split])
+        try:
+            obj = importlib.import_module(mod_name)
+        except ImportError as e:
+            err = e
+            continue
+        for attr in parts[split:]:
+            obj = getattr(obj, attr)  # AttributeError propagates to caller
+        return obj
+    raise AttributeError(f"cannot resolve {dotted!r}: {err}")
+
+
+def resolve(name: str) -> Any:
+    """Resolve a :data:`COMPAT_TABLE` entry by name against installed JAX.
+
+    Returns the first available candidate; raises AttributeError naming
+    every candidate tried when none resolves (a genuinely incompatible JAX).
+    """
+    for entry in COMPAT_TABLE:
+        if entry.name == name:
+            break
+    else:
+        raise KeyError(f"no compat entry named {name!r}")
+    tried = []
+    for dotted in entry.candidates:
+        try:
+            return _lookup(dotted)
+        except (AttributeError, ImportError) as e:
+            tried.append(f"{dotted} ({e.__class__.__name__})")
+    raise AttributeError(
+        f"compat: none of the candidate spellings for {name!r} exist on "
+        f"jax=={jax.__version__}: {', '.join(tried)}"
+    )
+
+
+_shard_map_impl: Callable = resolve("shard_map")
+
+# 0.4/0.5 call the replication check `check_rep`; 0.6+ renamed it
+# `check_vma`. Detect which one the resolved implementation takes.
+_sm_params = frozenset(inspect.signature(_shard_map_impl).parameters)
+_SHARD_MAP_CHECK_KW = "check_vma" if "check_vma" in _sm_params else "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None, **kwargs):
+    """``shard_map`` across JAX versions.
+
+    Accepts the modern ``check_vma`` kwarg and forwards it under whichever
+    name the installed implementation takes (``check_rep`` on 0.4/0.5).
+    Extra kwargs pass through untouched.
+    """
+    if check_vma is not None:
+        kwargs[_SHARD_MAP_CHECK_KW] = check_vma
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+_axis_size_impl: Callable = resolve("axis_size")
+
+
+def axis_size(axis) -> int:
+    """Static size of a named mesh axis (or product over an axis tuple),
+    callable from inside a traced region. Newer JAX spells this
+    ``lax.axis_size`` (which takes tuples natively); 0.4.x needs
+    ``jax.core.axis_frame`` per single axis."""
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= int(_axis_size_impl(a))
+        return n
+    return int(_axis_size_impl(axis))
+
+
+tree_map: Callable = resolve("tree_map")
+register_dataclass: Callable = resolve("register_dataclass")
+pure_callback: Callable = resolve("pure_callback")
+io_callback: Callable = resolve("io_callback")

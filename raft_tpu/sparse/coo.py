@@ -19,13 +19,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import compat
+
 __all__ = [
     "COO", "CSR", "coo_from_dense", "csr_from_coo", "coo_from_csr",
     "csr_from_scipy",
 ]
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class COO:
     """Coordinate-format sparse matrix (reference sparse/coo.hpp:29 COO<T>).
@@ -59,7 +61,7 @@ class COO:
         return jnp.zeros((m,), jnp.int32).at[self.rows].add(ones)
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class CSR:
     """Compressed-sparse-row matrix (reference sparse/csr.hpp).

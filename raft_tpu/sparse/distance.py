@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from raft_tpu import errors
+from raft_tpu import compat, errors
 from raft_tpu.distance.distance_type import (
     DistanceType,
     EXPANDED_METRICS,
@@ -308,7 +308,7 @@ def _colblock_pair_dists(a, b, metric, p, col_block, block_n,
 # ---------------------------------------------------------------------------
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class SparseColBlockIndex:
     """Entries grouped by column block, sorted by (row, local col) within a

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu import errors
+from raft_tpu import compat, errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.distance.pairwise import haversine_core, haversine_distance
 from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
@@ -42,7 +42,7 @@ from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
 __all__ = ["BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query"]
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class BallCoverIndex:
     """Analog of BallCoverIndex (ball_cover_common.h:38)."""

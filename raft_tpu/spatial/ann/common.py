@@ -18,10 +18,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import compat
+
 __all__ = ["ListStorage", "build_list_storage", "split_oversized_lists"]
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class ListStorage:
     """Sorted-by-list container.

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from raft_tpu import errors
+from raft_tpu import compat, errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.spatial.ann.common import (
     ListStorage,
@@ -54,7 +54,7 @@ class IVFFlatParams:
     max_list_cap: typing.Optional[int] = None
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class IVFFlatIndex:
     centroids: jax.Array      # (n_lists, d)

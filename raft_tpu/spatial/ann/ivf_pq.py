@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from raft_tpu import errors
+from raft_tpu import compat, errors
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit, kmeans_predict
 from raft_tpu.spatial.ann.common import (
     ListStorage,
@@ -82,7 +82,7 @@ class IVFPQParams:
     max_list_cap: typing.Optional[int] = None
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class IVFPQIndex:
     centroids: jax.Array      # (n_lists, d)

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import compat
+
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.spatial.ann.common import (
     ListStorage,
@@ -37,7 +39,7 @@ class IVFSQParams:
     max_list_cap: typing.Optional[int] = None
 
 
-@jax.tree_util.register_dataclass
+@compat.register_dataclass
 @dataclasses.dataclass
 class IVFSQIndex:
     centroids: jax.Array      # (n_lists, d)

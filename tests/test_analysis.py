@@ -1,0 +1,543 @@
+"""jaxlint (raft_tpu.analysis) unit tests — fixture snippets per rule.
+
+Pure AST work: nothing here executes JAX, so the whole file runs in tier-1
+with no mesh/TPU. Each rule gets a true positive, a true negative, a
+suppression check; the engine gets baseline, JSON output, and CLI checks.
+The final test is the self-gate: the repo's own source must lint clean,
+and a seeded jax.shard_map fixture must be flagged (the acceptance
+criterion for the seed breakage class this subsystem exists to prevent).
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from raft_tpu.analysis import Baseline, lint_paths, lint_source
+from raft_tpu.analysis.rules import ALL_RULES
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def findings(src, rule=None):
+    out = lint_source(textwrap.dedent(src))
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def rules_hit(src):
+    return {f.rule for f in findings(src)}
+
+
+# -- api-compat --------------------------------------------------------------
+
+def test_api_compat_flags_direct_shard_map():
+    out = findings("""
+        import jax
+        f = jax.shard_map(lambda x: x, mesh=m, in_specs=s, out_specs=s)
+    """, "api-compat")
+    assert len(out) == 1
+    assert "jax.shard_map" in out[0].message
+    assert "raft_tpu.compat.shard_map" in out[0].message
+
+
+def test_api_compat_flags_experimental_import_form():
+    out = findings("""
+        from jax.experimental.shard_map import shard_map
+    """, "api-compat")
+    assert len(out) == 1
+
+
+def test_api_compat_flags_aliased_root():
+    # alias resolution: `import jax as j` must not hide the hazard
+    out = findings("""
+        import jax as j
+        f = j.tree_map(lambda x: x, t)
+    """, "api-compat")
+    assert len(out) == 1
+
+
+def test_api_compat_true_negative_compat_usage():
+    out = findings("""
+        from raft_tpu import compat
+        f = compat.shard_map(lambda x: x, mesh=m, in_specs=s, out_specs=s)
+        g = compat.tree_map(lambda x: x, t)
+    """, "api-compat")
+    assert out == []
+
+
+def test_api_compat_one_finding_per_use_not_per_attribute_level():
+    out = findings("""
+        import jax
+        f = jax.experimental.shard_map.shard_map(g, mesh=m, in_specs=s,
+                                                 out_specs=s)
+    """, "api-compat")
+    assert len(out) == 1
+
+
+def test_api_compat_suppression_honored():
+    out = findings("""
+        import jax
+        f = jax.shard_map(g, mesh=m, in_specs=s, out_specs=s)  # jaxlint: disable=api-compat
+    """)
+    assert [f for f in out if f.rule == "api-compat"] == []
+
+
+# -- tracer-safety -----------------------------------------------------------
+
+def test_tracer_safety_flags_np_asarray_in_jit():
+    out = findings("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + 1
+    """, "tracer-safety")
+    assert len(out) == 1
+    assert "materializes" in out[0].message
+
+
+def test_tracer_safety_flags_coercion_and_item():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            a = float(x)
+            b = jnp.sum(x).item()
+            return a + b
+    """, "tracer-safety")
+    assert len(out) == 2
+
+
+def test_tracer_safety_flags_python_if_on_traced_param():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """, "tracer-safety")
+    assert len(out) == 1
+    assert "lax.cond" in out[0].message
+
+
+def test_tracer_safety_callable_passed_to_shard_map_call():
+    # traced via call form, not decorator: comms.shard_map(body, ...)
+    out = findings("""
+        import numpy as np
+
+        def body(x):
+            return np.asarray(x)
+
+        sm = comms.shard_map(body, in_specs=s, out_specs=s)
+    """, "tracer-safety")
+    assert len(out) == 1
+
+
+def test_tracer_safety_true_negatives():
+    out = findings("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x, tiled):
+            if x.shape[0] > 4:          # static metadata: fine
+                y = x * 2
+            else:
+                y = x
+            return y
+
+        def host(x):
+            return np.asarray(x)        # host code: numpy is fine
+
+        @jax.jit
+        def g(x, n=None):
+            if n is None:               # identity check: host-side
+                n = x.shape[0]
+            return x[:n]
+    """, "tracer-safety")
+    assert out == []
+
+
+def test_tracer_safety_static_argnames_param_may_branch():
+    out = findings("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "fast":
+                return x
+            return x * 2
+    """, "tracer-safety")
+    assert out == []
+
+
+def test_tracer_safety_builtin_map_is_not_a_transform():
+    # Python's map() must not mark its callable as traced (lax.map does)
+    out = findings("""
+        import numpy as np
+
+        def convert(x):
+            return np.asarray(x)
+
+        rows2 = list(map(convert, rows))
+    """, "tracer-safety")
+    assert out == []
+    out2 = findings("""
+        import numpy as np
+        from jax import lax
+
+        def convert(x):
+            return np.asarray(x)
+
+        rows2 = lax.map(convert, rows)
+    """, "tracer-safety")
+    assert len(out2) == 1
+
+
+# -- recompile-hazard --------------------------------------------------------
+
+def test_recompile_hazard_dynamic_static_spec():
+    out = findings("""
+        import jax
+        spec = compute_spec()
+        f = jax.jit(g, static_argnums=spec)
+    """, "recompile-hazard")
+    assert len(out) == 1
+    assert "static_argnums" in out[0].message
+
+
+def test_recompile_hazard_literal_spec_ok():
+    out = findings("""
+        import jax
+        f = jax.jit(g, static_argnums=(0, 1))
+        h = jax.jit(g, static_argnames=("k",))
+    """, "recompile-hazard")
+    assert out == []
+
+
+def test_recompile_hazard_mutable_default():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def f(x, opts={}):
+            return x
+    """, "recompile-hazard")
+    assert len(out) == 1
+    assert "mutable default" in out[0].message
+
+
+def test_recompile_hazard_fstring_in_traced_body():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            key = f"shape={x.shape}"
+            return cache[key] * x
+    """, "recompile-hazard")
+    assert len(out) == 1
+
+
+def test_recompile_hazard_mutated_closure_capture():
+    out = findings("""
+        import jax
+
+        def outer(xs):
+            step = 0
+            def body(x):
+                return x + step
+            for x in xs:
+                step += 1
+                run(jax.jit(body), x)
+    """, "recompile-hazard")
+    assert len(out) == 1
+    assert "varies per call" in out[0].message
+
+
+def test_recompile_hazard_fstring_on_host_ok():
+    out = findings("""
+        import jax
+
+        def host(x):
+            label = f"n={x.shape[0]}"   # host-side formatting: fine
+            return label
+    """, "recompile-hazard")
+    assert out == []
+
+
+# -- x64-hygiene -------------------------------------------------------------
+
+def test_x64_flags_unguarded_jnp_float64():
+    out = findings("""
+        import jax.numpy as jnp
+        y = x.astype(jnp.float64)
+    """, "x64-hygiene")
+    assert len(out) == 1
+
+
+def test_x64_guarded_use_is_exempt():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+        d = jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32
+    """, "x64-hygiene")
+    assert out == []
+
+
+def test_x64_flags_wide_dtype_kwarg_at_jnp_boundary():
+    out = findings("""
+        import jax.numpy as jnp
+        import numpy as np
+        a = jnp.zeros(8, dtype=np.float64)
+        b = jnp.arange(8, dtype="int64")
+        c = jnp.asarray(x, dtype=float)
+    """, "x64-hygiene")
+    assert len(out) == 3
+
+
+def test_x64_host_numpy_not_flagged():
+    out = findings("""
+        import numpy as np
+        a = np.zeros(8, dtype=np.float64)   # host numpy: allowed
+    """, "x64-hygiene")
+    assert out == []
+
+
+def test_x64_disabling_or_unrelated_store_is_not_exempt():
+    # storing a FALSY value (or into an unrelated dict) must not silence
+    # the rule — only an actual enable is the harness pattern
+    out = findings("""
+        import os
+        import jax.numpy as jnp
+        os.environ["JAX_ENABLE_X64"] = "0"
+        a = jnp.zeros(8, dtype=jnp.float64)
+    """, "x64-hygiene")
+    assert len(out) == 1
+    out2 = findings("""
+        import jax.numpy as jnp
+        cfg = {}
+        cfg["JAX_ENABLE_X64"] = "1"
+        a = jnp.zeros(8, dtype=jnp.float64)
+    """, "x64-hygiene")
+    assert len(out2) == 1
+
+
+def test_x64_env_enable_is_exempt():
+    out = findings("""
+        import os
+        import jax.numpy as jnp
+        os.environ["JAX_ENABLE_X64"] = "1"
+        a = jnp.zeros(8, dtype=jnp.float64)
+    """, "x64-hygiene")
+    assert out == []
+
+
+def test_x64_harness_module_exempt_wholesale():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+        jax.config.update("jax_enable_x64", True)
+        a = jnp.zeros(8, dtype=jnp.float64)
+    """, "x64-hygiene")
+    assert out == []
+
+
+# -- prng-discipline ---------------------------------------------------------
+
+def test_prng_flags_key_reuse():
+    out = findings("""
+        import jax
+
+        def f():
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+    """, "prng-discipline")
+    assert len(out) == 1
+    assert "replay the same stream" in out[0].message
+
+
+def test_prng_split_and_fold_in_are_clean():
+    out = findings("""
+        import jax
+
+        def f():
+            key = jax.random.PRNGKey(0)
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (4,))
+            b = jax.random.uniform(k2, (4,))
+            c = jax.random.normal(jax.random.fold_in(key, 7), (4,))
+            return a + b + c
+    """, "prng-discipline")
+    assert out == []
+
+
+def test_prng_reassignment_refreshes():
+    out = findings("""
+        import jax
+
+        def f():
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, (4,))
+            key = jax.random.fold_in(key, 1)
+            b = jax.random.normal(key, (4,))
+            return a + b
+    """, "prng-discipline")
+    assert out == []
+
+
+def test_prng_exclusive_branches_not_flagged():
+    # if/else arms are mutually exclusive — one draw each is fine; but a
+    # draw AFTER the branches still sees the key as consumed
+    out = findings("""
+        import jax
+
+        def f(cond):
+            key = jax.random.PRNGKey(0)
+            if cond:
+                a = jax.random.normal(key, (4,))
+            else:
+                a = jax.random.uniform(key, (4,))
+            return a
+    """, "prng-discipline")
+    assert out == []
+    out2 = findings("""
+        import jax
+
+        def f(cond):
+            key = jax.random.PRNGKey(0)
+            if cond:
+                a = jax.random.normal(key, (4,))
+            else:
+                a = jax.random.uniform(key, (4,))
+            return a + jax.random.normal(key, (4,))
+    """, "prng-discipline")
+    assert len(out2) == 1
+
+
+def test_prng_suppression_honored():
+    out = findings("""
+        import jax
+
+        def f():
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))  # jaxlint: disable=prng-discipline
+            return a + b
+    """)
+    assert [f for f in out if f.rule == "prng-discipline"] == []
+
+
+# -- engine: baseline, CLI, self-gate ---------------------------------------
+
+FIXTURE_BAD = textwrap.dedent("""
+    import jax
+    f = jax.shard_map(lambda x: x, mesh=m, in_specs=s, out_specs=s)
+""")
+
+
+def test_baseline_respected_and_counted(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURE_BAD)
+    result = lint_paths([bad], root=tmp_path)
+    assert len(result.findings) == 1
+
+    bl_path = tmp_path / "baseline.json"
+    Baseline().save(bl_path, result.findings)
+    bl = Baseline.load(bl_path)
+
+    result2 = lint_paths([bad], root=tmp_path, baseline=bl)
+    assert result2.findings == []          # grandfathered
+    assert result2.baselined == 1
+    assert result2.clean
+
+    # a SECOND identical finding exceeds the baselined count -> new
+    bad.write_text(FIXTURE_BAD + "g = jax.shard_map(h, mesh=m, "
+                   "in_specs=s, out_specs=s)\n")
+    result3 = lint_paths([bad], root=tmp_path, baseline=bl)
+    assert len(result3.findings) == 1
+    assert not result3.clean
+
+
+def test_parse_error_is_reported_not_crash(tmp_path):
+    bad = tmp_path / "syn.py"
+    bad.write_text("def broken(:\n")
+    result = lint_paths([bad], root=tmp_path)
+    assert len(result.parse_errors) == 1
+    assert not result.clean
+
+
+def test_cli_json_format_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURE_BAD)
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--format", "json",
+         "--no-baseline", str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["checked_files"] == 1
+    assert len(payload["findings"]) == 1
+    assert payload["findings"][0]["rule"] == "api-compat"
+
+    good = tmp_path / "good.py"
+    good.write_text("from raft_tpu import compat\n"
+                    "f = compat.tree_map(abs, [1])\n")
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--format", "json",
+         "--no-baseline", str(good)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+
+
+def test_cli_rule_filter_and_list(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    for rule in ALL_RULES:
+        assert rule.name in proc.stdout
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURE_BAD)
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--rules",
+         "prng-discipline", "--no-baseline", str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc2.returncode == 0  # api-compat finding filtered out
+
+
+@pytest.mark.parametrize("rule", [r.name for r in ALL_RULES])
+def test_every_rule_has_description(rule):
+    r = next(r for r in ALL_RULES if r.name == rule)
+    assert r.description
+
+
+def test_repo_lints_clean():
+    """The CI gate, as a test: the repo's own source has no new findings."""
+    targets = ["raft_tpu", "tests", "bench", "ci",
+               "bench.py", "__graft_entry__.py"]
+    baseline_path = REPO / "ci" / "checks" / "jaxlint_baseline.json"
+    baseline = Baseline.load(baseline_path) if baseline_path.exists() \
+        else None
+    result = lint_paths([REPO / t for t in targets], root=REPO,
+                        baseline=baseline)
+    msgs = [f.render() for f in result.parse_errors + result.findings]
+    assert result.clean, "\n".join(msgs)

@@ -31,6 +31,23 @@ def _free_port() -> int:
 
 @pytest.fixture(scope="module")
 def worker_reports():
+    # one retry on a fresh port: the gloo/coordination-service bring-up
+    # can flake on a loaded 1-core host (heartbeat timeout while a worker
+    # is stuck in a long XLA compile) — a real failure fails both rounds
+    # and surfaces both workers' stderr
+    try:
+        return _spawn_and_collect()
+    except AssertionError as first:
+        try:
+            return _spawn_and_collect()
+        except AssertionError as second:
+            raise AssertionError(
+                f"bring-up failed twice.\n-- first attempt --\n{first}\n"
+                f"-- second attempt --\n{second}"
+            ) from second
+
+
+def _spawn_and_collect():
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     env = {
@@ -45,20 +62,40 @@ def worker_reports():
         )
         for r in range(N_PROCS)
     ]
+    # every bring-up failure mode must surface worker stderr in the
+    # assertion: a bare TimeoutExpired/IndexError here cost a triage
+    # round-trip when the shard_map AttributeError first broke the workers
     outs = []
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=420)
-            assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
-            outs.append(out)
+        for rank, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                out, err = p.communicate()
+                raise AssertionError(
+                    f"worker {rank} timed out after 420s; stderr:\n"
+                    f"{err[-4000:]}\nstdout tail:\n{out[-1000:]}"
+                )
+            assert p.returncode == 0, (
+                f"worker {rank} exited {p.returncode}; stderr:\n"
+                f"{err[-4000:]}\nstdout tail:\n{out[-1000:]}"
+            )
+            outs.append((out, err))
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
     reports = []
-    for out in outs:
-        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
-        reports.append(json.loads(line))
+    for rank, (out, err) in enumerate(outs):
+        json_lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        assert json_lines, (
+            f"worker {rank} exited 0 but emitted no JSON report; stdout:\n"
+            f"{out[-2000:]}\nstderr tail:\n{err[-2000:]}"
+        )
+        reports.append(json.loads(json_lines[-1]))
     return sorted(reports, key=lambda r: r["rank"])
 
 
