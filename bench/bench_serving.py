@@ -1,0 +1,152 @@
+"""Serving-latency surface — p50 dispatch latency at small batch for the
+three serving engines (fused exact kNN, grouped IVF-Flat, grouped
+IVF-PQ), swept over nq ∈ {1, 128, 1024} at the shared 500k x 96 bench
+config (docs/serving.md; the reference treats n_queries as a first-class
+sweep axis, cpp/bench/spatial/knn.cu:34-60).
+
+Methodology: each point is a chained-dispatch quotient
+(bench/common.py) — the chain is device-serialized by a data
+dependence, so with no pipelining the per-dispatch quotient IS the
+program's dispatch-to-done latency, and the two-point difference
+cancels the ~100 ms axon-tunnel round trip that a naive
+time-one-dispatch-and-block measurement would report as "latency". The
+median over the (spread-escalated 3-7) repeats is the reported p50.
+
+The serving recipe under measurement is the docs/serving.md one:
+explicit integer qcap resolved by ``index.warmup(nq)`` (no per-call
+host sync, no data-dependent re-trace), program caches warmed before
+the clock starts, one jitted program per (engine, nq).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+NQS = (1, 128, 1024)
+
+
+def serving_latency_rows(
+    n: int = 500_000, d: int = 96, k: int = 10, n_probes: int = 16,
+    n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
+                                           "ivf_pq"),
+    chain=(4, 32), escalate: int = 2,
+):
+    """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
+    "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
+    engine cannot sink the sweep). Parameterized so tests can run a tiny
+    config on CPU; the bench defaults are the shared 500k x 96 shape."""
+    from bench.common import chained_dispatch_stats
+    from raft_tpu.distance.distance_type import DistanceType
+    from raft_tpu.random import make_blobs
+    from raft_tpu.random.rng import RngState
+    from raft_tpu.spatial.ann import (
+        IVFFlatParams, IVFPQParams, ivf_flat_build, ivf_pq_build,
+    )
+    from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped
+    from raft_tpu.spatial.fused_knn import fused_l2_knn
+
+    # same synthesis as bench.common.ann_bench_dataset (clustered blobs,
+    # perturbed dataset-point queries) minus the exact oracle — latency
+    # rows carry no recall claim, and the oracle would double the setup
+    key = jax.random.PRNGKey(2)
+    x, _ = make_blobs(n, d, n_clusters=min(1000, max(2, n // 100)),
+                      cluster_std=1.0, state=RngState(7))
+    base = jax.random.choice(key, x, shape=(max(nqs),), axis=0)
+    qall = base + 0.3 * jax.random.normal(
+        jax.random.fold_in(key, 1), (max(nqs), d), jnp.float32
+    )
+    jax.block_until_ready(qall)
+    cap = max(64, 2 * -(-n // n_lists) // 8 * 8) if n >= 100_000 else 0
+
+    built = {}
+
+    def get_index(engine):
+        if engine not in built:
+            if engine == "ivf_flat":
+                built[engine] = ivf_flat_build(x, IVFFlatParams(
+                    n_lists=n_lists, kmeans_n_iters=10,
+                    kmeans_init="random",
+                    max_list_cap=cap or None,
+                ), metric="sqeuclidean")
+            elif engine == "ivf_pq":
+                # the 500k QPS row's pq_dim=24; smaller d falls back to
+                # the largest divisor <= 24 (tiny test configs)
+                pq_dim = max(
+                    m for m in range(1, d + 1) if d % m == 0 and m <= 24
+                )
+                built[engine] = ivf_pq_build(x, IVFPQParams(
+                    n_lists=n_lists, pq_dim=pq_dim, kmeans_n_iters=10,
+                    kmeans_init="random", max_list_cap=cap or None,
+                ))
+            elif engine == "fused_knn":
+                norms = jnp.einsum(
+                    "nd,nd->n", x, x, preferred_element_type=jnp.float32
+                )
+                built[engine] = norms
+        return built[engine]
+
+    rows = []
+    for engine in engines:
+        for nq in nqs:
+            row = {"engine": engine, "nq": nq}
+            try:
+                qb = qall[:nq]
+                if engine == "fused_knn":
+                    norms = get_index(engine)
+
+                    def run(qq):
+                        return fused_l2_knn(
+                            qq, x, k, metric=DistanceType.L2Expanded,
+                            index_norms=norms,
+                        )
+                elif engine == "ivf_flat":
+                    idx = get_index(engine)
+                    qcap = idx.warmup(nq, k=k, n_probes=n_probes)
+                    row["qcap"] = qcap
+
+                    def run(qq, idx=idx, qcap=qcap):
+                        return ivf_flat_search_grouped(
+                            idx, qq, k, n_probes=n_probes, qcap=qcap,
+                        )
+                else:
+                    idx = get_index(engine)
+                    qcap = idx.warmup(
+                        nq, k=k, n_probes=n_probes, refine_ratio=4.0,
+                    )
+                    row["qcap"] = qcap
+
+                    def run(qq, idx=idx, qcap=qcap):
+                        return ivf_pq_search_grouped(
+                            idx, qq, k, n_probes=n_probes, qcap=qcap,
+                            refine_ratio=4.0,
+                        )
+
+                warm = run(qb)[0]                    # compile + warm
+                float(jnp.sum(jnp.where(jnp.isfinite(warm), warm, 0.0)))
+                st = chained_dispatch_stats(
+                    lambda s, qb=qb: qb * (1.0 + 1e-6 * s), run,
+                    n1=chain[0], n2=chain[1], escalate=escalate,
+                )
+                if st is None:
+                    row["error"] = "jitter-dominated"
+                else:
+                    row["p50_ms"] = round(st["ms"], 3)
+                    row["spread"] = st["spread"]
+                    row["repeats"] = st["repeats"]
+            except Exception as e:                   # noqa: BLE001 — one
+                # failed point must not sink the other 8 rows
+                row["error"] = f"{type(e).__name__}: {e}"[:160]
+            rows.append(row)
+    return {
+        "metric": f"serving_p50_{n}x{d}_k{k}_p{n_probes}",
+        "unit": "ms",
+        "rows": rows,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(serving_latency_rows()))

@@ -121,6 +121,15 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
     cpp/bench/common/benchmark.hpp:64). None when all quotients are
     non-positive (jitter-dominated: too fast to resolve this way).
 
+    Noisy rows earn more repeats automatically: when the spread over the
+    initial ``reps`` quotients exceeds ``spread_target`` (0.1), two more
+    quotients are collected, then two more — 3 -> 5 -> 7 — before
+    reporting. A row whose spread still exceeds the target after
+    ``max_reps`` repeats reports it honestly; downstream, bench.py stamps
+    ``vs_prev_significant: false`` on any round-over-round ratio smaller
+    than the row's own spread, so regression tracking never reads noise
+    as signal.
+
     ``escalate``: on a jitter-dominated result, retry up to this many
     times with 4x-longer chains — the one shared knob for
     millisecond-scale programs whose signal must be stretched above the
@@ -142,16 +151,28 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
 
     off = _salt0
     quotients = []
-    for rep in range(reps):
+
+    def add_quotient():
+        nonlocal off
         t1 = timed(n1, off)
         off += n1
         t2 = timed(n2, off)
         off += n2
         quotients.append((t2 - t1) / (n2 - n1) * 1e3)
-    # the jitter guard takes the median over ALL quotients (negative ones
-    # included): filtering negatives first would let one outlier positive
-    # masquerade as a confident measurement on a jitter-dominated workload
-    ms = sorted(quotients)[len(quotients) // 2]
+
+    def summarize():
+        # the jitter guard takes the median over ALL quotients (negative
+        # ones included): filtering negatives first would let one outlier
+        # positive masquerade as a confident measurement on a
+        # jitter-dominated workload
+        ms = sorted(quotients)[len(quotients) // 2]
+        pos = sorted(q for q in quotients if q > 0)
+        spread = (pos[-1] - pos[0]) / ms if (pos and ms > 0) else 0.0
+        return ms, pos, spread
+
+    for rep in range(reps):
+        add_quotient()
+    ms, pos, spread = summarize()
     if ms <= 0:
         if escalate > 0:
             return chained_dispatch_stats(
@@ -159,12 +180,27 @@ def chained_dispatch_stats(make_input, run, n1: int = 2, n2: int = 8,
                 escalate=escalate - 1, _salt0=off,
             )
         return None
-    pos = sorted(q for q in quotients if q > 0)
+    # spread-driven repeat escalation: 3 -> 5 -> 7 while the spread
+    # exceeds the 0.1 band (see docstring)
+    max_reps, spread_target = 7, 0.1
+    n_used = len(quotients)
+    while spread > spread_target and len(quotients) + 2 <= max_reps:
+        saved = (ms, pos, spread, n_used)
+        add_quotient()
+        add_quotient()
+        ms, pos, spread = summarize()
+        n_used = len(quotients)
+        if ms <= 0:
+            # jitter dragged the escalated median non-positive: keep the
+            # last valid summary rather than discarding a row the
+            # initial repeats already measured positively
+            ms, pos, spread, n_used = saved
+            break
     return {
         "ms": ms,
         "ms_min": pos[0],
-        "spread": round((pos[-1] - pos[0]) / ms, 3),
-        "repeats": reps,
+        "spread": round(spread, 3),
+        "repeats": n_used,
     }
 
 

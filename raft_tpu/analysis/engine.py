@@ -264,6 +264,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         rules = [r for r in ALL_RULES if r.name in wanted]
 
+    if args.write_baseline and args.rules:
+        print("jaxlint: --write-baseline with --rules would rewrite the "
+              "baseline from a rule subset, dropping every other rule's "
+              "grandfathered findings; run --write-baseline with the "
+              "full rule set", file=sys.stderr)
+        return 2
+
     baseline_path = args.baseline or DEFAULT_BASELINE
     baseline: Optional[Baseline] = None
     if not args.no_baseline and not args.write_baseline \

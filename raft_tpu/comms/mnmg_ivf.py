@@ -61,8 +61,9 @@ from raft_tpu.spatial.ann.ivf_pq import (
 from raft_tpu.spatial.selection import select_k
 
 __all__ = [
-    "MnmgIVFPQIndex", "mnmg_ivf_pq_build", "mnmg_ivf_pq_build_distributed",
-    "mnmg_ivf_pq_search", "place_index", "shard_rows",
+    "MnmgIVFPQIndex", "expand_probe_set", "mnmg_ivf_pq_build",
+    "mnmg_ivf_pq_build_distributed", "mnmg_ivf_pq_search", "place_index",
+    "shard_rows",
 ]
 
 
@@ -93,6 +94,35 @@ class MnmgIVFPQIndex:
     nl_pad: int = dataclasses.field(metadata=dict(static=True))
     max_list: int = dataclasses.field(metadata=dict(static=True))
     n_rows: int = dataclasses.field(metadata=dict(static=True))
+
+    def warmup(self, comms: "Comms", nq: int, *, k: int = 10,
+               n_probes: int = 8, qcap=None, list_block: int = 8,
+               refine_ratio: float = 2.0, exact_selection: bool = True,
+               approx_recall_target: float = 0.95,
+               donate_queries: bool = False) -> int:
+        """Pre-compile the sharded serving program for (nq, d) float32
+        batches: one all-zeros batch runs through
+        :func:`mnmg_ivf_pq_search` and is blocked on, so the first real
+        batch pays dispatch, not trace+compile (and the compile lands in
+        the persistent cache when enabled — docs/serving.md).
+
+        Returns the shape-only-resolved qcap
+        (:func:`raft_tpu.spatial.ann.common.static_qcap`); pass exactly
+        that integer (and the same ``donate_queries``) on serving
+        dispatches — the compiled program is keyed on both."""
+        from raft_tpu.spatial.ann.common import static_qcap
+
+        qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
+        q0 = jnp.zeros((nq, self.centroids.shape[1]), jnp.float32)
+        out = mnmg_ivf_pq_search(
+            comms, self, q0, k, n_probes=n_probes, qcap=qc,
+            list_block=list_block, refine_ratio=refine_ratio,
+            exact_selection=exact_selection,
+            approx_recall_target=approx_recall_target,
+            donate_queries=donate_queries,
+        )
+        jax.block_until_ready(out)
+        return qc
 
 
 # bounded cache of compiled build-phase shard_map programs keyed on
@@ -693,14 +723,19 @@ def place_index(comms: Comms, index):
 
 @functools.lru_cache(maxsize=32)
 def _cached_search(
-    mesh: jax.sharding.Mesh, axis: str, store_raw: bool, statics: tuple
+    mesh: jax.sharding.Mesh, axis: str, store_raw: bool, statics: tuple,
+    donate: bool = False,
 ):
     """Compile one shard_map search program per (mesh, static-config).
 
     Keyed on (mesh, axis) — both value-hashable — rather than the Comms
     object (identity-hashed): a caller constructing a fresh Comms per
     search still hits the cached program, and the cache never retains
-    dead Comms instances."""
+    dead Comms instances.
+
+    ``donate=True`` donates the query buffer to the runtime (serving
+    dispatch: the output may alias the input's memory and no copy of the
+    batch survives the call — the caller must not reuse the array)."""
     (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
      approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list) = statics
     comms = Comms(mesh=mesh, axis=axis)
@@ -765,7 +800,48 @@ def _cached_search(
     sm = comms.shard_map(
         body, in_specs=in_specs, out_specs=(rep2, rep2)
     )
-    return jax.jit(sm)
+    # queries are the last positional argument; donation frees/aliases the
+    # batch buffer for the outputs (index slabs are never donated)
+    return jax.jit(sm, donate_argnums=(10,) if donate else ())
+
+
+def expand_probe_set(index, extra_centroids):
+    """Extend a sharded index's GLOBAL probe set with centroids owned by
+    no rank — the deployment view that turns the per-chip serving cost
+    into ONE measured program on fewer chips than the deployment holds.
+
+    The fused search program probes the full (replicated) centroid set
+    and routes unowned probes to the empty sentinel list; centroids added
+    here carry owner ``-1``, which no rank matches, so they behave
+    exactly like lists owned by an absent peer chip. Searching the
+    returned index on a 1-device mesh therefore runs a chip's exact share
+    of a larger deployment — deployment-scale coarse probe fused with the
+    shard-local search, one dispatch, no host composition — and only the
+    cross-chip merge remains to be modeled (bench.py's
+    ``measured_chip_qps`` rows). Works on both sharded engines (field
+    names are shared); slabs are aliased, not copied.
+    """
+    extra = jnp.asarray(extra_centroids, jnp.float32)
+    errors.expects(
+        extra.ndim == 2 and extra.shape[1] == index.centroids.shape[1],
+        "extra_centroids: expected (m, %d), got %s",
+        index.centroids.shape[1], tuple(extra.shape),
+    )
+    n_extra = extra.shape[0]
+    return dataclasses.replace(
+        index,
+        centroids=jnp.concatenate(
+            [jnp.asarray(index.centroids, jnp.float32), extra]
+        ),
+        owner=jnp.concatenate(
+            [jnp.asarray(index.owner),
+             jnp.full((n_extra,), -1, jnp.int32)]
+        ),
+        local_id=jnp.concatenate(
+            [jnp.asarray(index.local_id),
+             jnp.zeros((n_extra,), jnp.int32)]
+        ),
+    )
 
 
 def mnmg_ivf_pq_search(
@@ -775,6 +851,7 @@ def mnmg_ivf_pq_search(
     refine_ratio: float = 2.0, exact_selection: bool = True,
     approx_recall_target: float = 0.95,
     qcap_max_drop_frac: typing.Optional[float] = None,
+    donate_queries: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed grouped ADC search over a list-sharded index.
 
@@ -800,6 +877,12 @@ def mnmg_ivf_pq_search(
     ``qcap="throughput"`` picks ~0.75x the mean probe occupancy
     (common.throughput_qcap — measured 33k QPS vs 10k at the 500k bench
     shape at identical recall).
+
+    ``donate_queries=True`` donates the query buffer (outputs may reuse
+    its memory; the caller must not touch the array after the call) — the
+    serving-dispatch mode, paired with an explicit integer ``qcap`` and
+    :meth:`MnmgIVFPQIndex.warmup` so the dispatch is fully async with no
+    host-side sync or trace (docs/serving.md).
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -825,7 +908,9 @@ def mnmg_ivf_pq_search(
         approx_recall_target, index.pq_dim, index.pq_bits, index.n_pad,
         index.nl_pad, index.max_list,
     )
-    fn = _cached_search(comms.mesh, comms.axis, store_raw, statics)
+    fn = _cached_search(
+        comms.mesh, comms.axis, store_raw, statics, donate_queries
+    )
     vecs = (
         index.vectors_sorted if store_raw
         else jnp.zeros((comms.size, 1, 1), jnp.float32)

@@ -93,6 +93,27 @@ class MnmgIVFFlatIndex:
     n_rows: int = dataclasses.field(metadata=dict(static=True))
     metric: str = dataclasses.field(metadata=dict(static=True))
 
+    def warmup(self, comms: "Comms", nq: int, *, k: int = 10,
+               n_probes: int = 8, qcap=None, list_block: int = 32,
+               donate_queries: bool = False) -> int:
+        """Pre-compile the sharded serving program for (nq, d) float32
+        batches by dispatching one all-zeros batch through
+        :func:`mnmg_ivf_flat_search` — the Flat sibling of
+        :meth:`raft_tpu.comms.mnmg_ivf.MnmgIVFPQIndex.warmup`.
+
+        Returns the shape-only-resolved qcap; pass exactly that integer
+        (and the same ``donate_queries``) on serving dispatches."""
+        from raft_tpu.spatial.ann.common import static_qcap
+
+        qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
+        q0 = jnp.zeros((nq, self.centroids.shape[1]), jnp.float32)
+        out = mnmg_ivf_flat_search(
+            comms, self, q0, k, n_probes=n_probes, qcap=qc,
+            list_block=list_block, donate_queries=donate_queries,
+        )
+        jax.block_until_ready(out)
+        return qc
+
 
 def mnmg_ivf_flat_build(
     comms: Comms, x, params: IVFFlatParams = IVFFlatParams(), *,
@@ -223,10 +244,13 @@ def mnmg_ivf_flat_build_distributed(
 
 @functools.lru_cache(maxsize=32)
 def _cached_search(
-    mesh: jax.sharding.Mesh, axis: str, statics: tuple
+    mesh: jax.sharding.Mesh, axis: str, statics: tuple,
+    donate: bool = False,
 ):
     """Compile one shard_map search program per (mesh, static-config);
-    keyed on value-hashable (mesh, axis), not the Comms identity."""
+    keyed on value-hashable (mesh, axis), not the Comms identity.
+    ``donate=True`` donates the query buffer (serving dispatch; the
+    caller must not reuse the array after the call)."""
     (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
@@ -278,7 +302,8 @@ def _cached_search(
         sharded3, sharded3, sharded2, sharded2, sharded2, rep2,
     )
     sm = comms.shard_map(body, in_specs=in_specs, out_specs=(rep2, rep2))
-    return jax.jit(sm)
+    # queries are the last positional argument (donation: serving mode)
+    return jax.jit(sm, donate_argnums=(8,) if donate else ())
 
 
 def mnmg_ivf_flat_search(
@@ -286,6 +311,7 @@ def mnmg_ivf_flat_search(
     n_probes: int = 8, qcap: typing.Union[int, str, None] = None,
     list_block: int = 32,
     qcap_max_drop_frac: typing.Optional[float] = None,
+    donate_queries: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed grouped EXACT search over a list-sharded IVF-Flat
     index. Returns (distances, GLOBAL row ids), both (nq, k) replicated
@@ -300,6 +326,11 @@ def mnmg_ivf_flat_search(
     ``qcap`` as in the single-chip grouped search (``None`` = recall-safe
     auto from the global probe map; ``"throughput"`` = ~0.75x mean
     occupancy — see ann.common.throughput_qcap for when that is unsafe).
+
+    ``donate_queries=True`` donates the query buffer (outputs may reuse
+    its memory; the caller must not touch the array after the call) —
+    the serving-dispatch mode, paired with an explicit integer ``qcap``
+    and :meth:`MnmgIVFFlatIndex.warmup` (docs/serving.md).
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -325,7 +356,7 @@ def mnmg_ivf_flat_search(
         k, n_probes, qcap, list_block, index.n_pad, index.nl_pad,
         index.max_list,
     )
-    fn = _cached_search(comms.mesh, comms.axis, statics)
+    fn = _cached_search(comms.mesh, comms.axis, statics, donate_queries)
     vals, ids = fn(
         index.centroids, index.owner, index.local_id, index.local_cents,
         index.vectors_sorted, index.sorted_ids, index.list_offsets,

@@ -44,6 +44,7 @@ __all__ = [
     "register_dataclass",
     "pure_callback",
     "io_callback",
+    "compilation_cache_reset",
 ]
 
 
@@ -125,6 +126,22 @@ COMPAT_TABLE: Tuple[CompatEntry, ...] = (
         ),
         reason="graduated from jax.experimental in 0.4.27; the experimental "
                "alias is removed in newer releases",
+    ),
+    CompatEntry(
+        name="compilation_cache_reset",
+        candidates=(
+            "jax.experimental.compilation_cache.compilation_cache.reset_cache",
+            "jax._src.compilation_cache.reset_cache",
+        ),
+        banned=(
+            "jax.experimental.compilation_cache.compilation_cache",
+            "jax._src.compilation_cache",
+        ),
+        reason="the persistent-cache enable decision is memoized at the "
+               "first compile (is_cache_used); enabling the cache after "
+               "any jit has run requires reset_cache(), which lives under "
+               "experimental/_src — route through compat so the spelling "
+               "has one home (core/resources.py enable_compilation_cache)",
     ),
     CompatEntry(
         name="io_callback",
@@ -241,3 +258,4 @@ tree_map: Callable = resolve("tree_map")
 register_dataclass: Callable = resolve("register_dataclass")
 pure_callback: Callable = resolve("pure_callback")
 io_callback: Callable = resolve("io_callback")
+compilation_cache_reset: Callable = resolve("compilation_cache_reset")

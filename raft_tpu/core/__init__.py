@@ -6,7 +6,13 @@ NVTX ranges -> :mod:`annotate` (jax.profiler traces); ``interruptible`` ->
 :mod:`interruptible` (cooperative cancellation of host loops).
 """
 
-from raft_tpu.core.resources import Resources, DeviceResources, get_default_resources
+from raft_tpu.core.resources import (
+    Resources,
+    DeviceResources,
+    compilation_cache_dir,
+    enable_compilation_cache,
+    get_default_resources,
+)
 from raft_tpu.core import logger
 from raft_tpu.core.annotate import annotate, push_range, pop_range
 from raft_tpu.core.interruptible import Interruptible, InterruptedException as RaftInterruptedError
@@ -14,6 +20,8 @@ from raft_tpu.core.interruptible import Interruptible, InterruptedException as R
 __all__ = [
     "Resources",
     "DeviceResources",
+    "enable_compilation_cache",
+    "compilation_cache_dir",
     "get_default_resources",
     "logger",
     "annotate",

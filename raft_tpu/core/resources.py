@@ -47,6 +47,13 @@ class Resources:
     n_lanes : stream-pool-size analog (reference handle.hpp:158-237); used by
         batched algorithms to decide how many independent dispatches to keep
         in flight.
+    compilation_cache_dir : opt-in path for JAX's persistent compilation
+        cache. When set, :func:`enable_compilation_cache` runs with this
+        path — the cache is process-global, so EVERY builder/search entry
+        (all of them jit-compiled programs) transparently reads and writes
+        it from then on: a fresh process rebuilding a same-shape index pays
+        executable deserialization instead of XLA compilation (the serving
+        cold-start path, docs/serving.md "Warm start").
     """
 
     device: Any = None
@@ -55,10 +62,13 @@ class Resources:
     dtype: Any = np.float32
     matmul_precision: str = "highest"
     n_lanes: int = 1
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.device is None:
             self.device = jax.devices()[0]
+        if self.compilation_cache_dir is not None:
+            enable_compilation_cache(self.compilation_cache_dir)
 
     # -- comms slot ---------------------------------------------------------
     def set_mesh(self, mesh: jax.sharding.Mesh) -> None:
@@ -109,6 +119,65 @@ class Resources:
 
 # Backwards-compatible alias mirroring raft 22.08's rename handle_t -> device_resources
 DeviceResources = Resources
+
+_cache_lock = threading.Lock()
+_cache_dir_enabled: Optional[str] = None
+
+
+def enable_compilation_cache(
+    path: str,
+    *,
+    min_compile_time_secs: float = 0.0,
+    min_entry_size_bytes: int = -1,
+) -> None:
+    """Enable JAX's persistent compilation cache at ``path`` (idempotent).
+
+    Every jitted program compiled after this call — index builds, search
+    programs, the shard_map mesh programs — is serialized under ``path``
+    and deserialized by later processes instead of recompiled. The r5
+    bench showed compile, not compute, dominating builds (cold 125-250 s
+    vs 1.6-15 s warm); this turns that cold start into a disk read.
+
+    Two defaults differ deliberately from JAX's:
+
+    * ``min_compile_time_secs=0``: JAX skips caching programs that
+      compiled in under 1 s, but this library dispatches many small
+      helper programs per build whose compiles add up;
+    * ``min_entry_size_bytes=-1``: no size floor.
+
+    The enable decision is memoized by JAX at the FIRST compile of the
+    process (``is_cache_used``), so enabling after any jit has run needs a
+    cache reset — compat.compilation_cache_reset does that; in-memory
+    executables are unaffected. Thread-safe; re-enabling with the same
+    path is a no-op, a different path switches the cache over.
+    """
+    global _cache_dir_enabled
+    with _cache_lock:
+        if _cache_dir_enabled == path:
+            return
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_time_secs),
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes",
+            int(min_entry_size_bytes),
+        )
+        # drop the memoized "cache disabled" decision a pre-enable compile
+        # may have locked in (observed on jax 0.4.37: enabling after
+        # backend init silently writes nothing without this)
+        from raft_tpu import compat
+
+        compat.compilation_cache_reset()
+        _cache_dir_enabled = path
+
+
+def compilation_cache_dir() -> Optional[str]:
+    """The persistent-cache path enabled through this module, or None."""
+    with _cache_lock:
+        return _cache_dir_enabled
+
 
 _default_lock = threading.Lock()
 _default_resources: Optional[Resources] = None

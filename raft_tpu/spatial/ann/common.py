@@ -13,6 +13,7 @@ Probing gathers whole padded lists — rectangular, static-shape, MXU-friendly
 from __future__ import annotations
 
 import dataclasses
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -20,7 +21,10 @@ import numpy as np
 
 from raft_tpu import compat
 
-__all__ = ["ListStorage", "build_list_storage", "split_oversized_lists"]
+__all__ = [
+    "ListStorage", "build_list_storage", "split_oversized_lists",
+    "static_qcap",
+]
 
 
 @compat.register_dataclass
@@ -215,10 +219,56 @@ def throughput_qcap(nq: int, n_probes: int, n_lists: int) -> int:
     return min(nq, max(8, -(-(3 * mean_occ // 4) // 8) * 8))
 
 
-# (n_lists, n_probes, qcap, nq) signatures whose throughput-mode drop
-# fraction has already been audited+logged this process — the audit's
-# eager probe + host sync must not tax EVERY serving dispatch
-_THROUGHPUT_AUDITED: set = set()
+class _AuditRegistry:
+    """(n_lists, n_probes, qcap, nq) signatures whose throughput-mode drop
+    fraction has already been audited+logged this process, keyed by the
+    centroids ARRAY — the audit's eager probe + host sync must not tax
+    EVERY serving dispatch, but each distinct index deserves its own
+    first-call audit.
+
+    The key is a weakref to the centroids array, not ``id()`` alone: a
+    freed index's id is eligible for reuse, and a bare-id registry would
+    silently skip the audit on a NEW same-shape index that happened to
+    land on a recycled id (the build-free-rebuild serving pattern). Dead
+    entries evict themselves via the weakref callback."""
+
+    def __init__(self):
+        self._by_id: dict = {}    # id(arr) -> (weakref, set of sigs)
+
+    def _sigs(self, arr):
+        ent = self._by_id.get(id(arr))
+        if ent is not None and ent[0]() is arr:
+            return ent[1]
+        return None
+
+    def seen(self, arr, sig) -> bool:
+        sigs = self._sigs(arr)
+        return sigs is not None and sig in sigs
+
+    def add(self, arr, sig) -> None:
+        sigs = self._sigs(arr)
+        if sigs is None:
+            key = id(arr)
+
+            def _evict(_, key=key, reg=self._by_id):
+                reg.pop(key, None)
+
+            try:
+                ref = weakref.ref(arr, _evict)
+            except TypeError:
+                # non-weakrefable array type: hold it strongly (matches
+                # the old id()-keyed lifetime, minus the reuse hazard)
+                ref = (lambda a: (lambda: a))(arr)
+            sigs = set()
+            self._by_id[key] = (ref, sigs)
+        sigs.add(sig)
+
+    def clear(self) -> None:
+        """Forget every audit (tests re-arming the first-call audit)."""
+        self._by_id.clear()
+
+
+_THROUGHPUT_AUDITED = _AuditRegistry()
 
 
 def resolve_qcap_arg(qcap, q, centroids, n_lists: int, n_probes: int,
@@ -242,15 +292,18 @@ def resolve_qcap_arg(qcap, q, centroids, n_lists: int, n_probes: int,
     if qcap == "throughput":
         nq = q.shape[0]
         qc = throughput_qcap(nq, n_probes, n_lists)
-        # id(centroids) fingerprints the INDEX, not just the shape — a
-        # second same-shape index with a hot-skewed distribution must be
-        # audited too (a process-lifetime heuristic: the centroids array
-        # is alive as long as its index is)
-        sig = (id(centroids), n_lists, n_probes, qc, nq)
+        # the centroids array fingerprints the INDEX, not just the shape —
+        # a second same-shape index with a hot-skewed distribution must be
+        # audited too (the array is alive as long as its index is; the
+        # registry keys it by weakref so a recycled id cannot alias)
+        sig = (n_lists, n_probes, qc, nq)
         traced = isinstance(q, jax.core.Tracer) or isinstance(
             centroids, jax.core.Tracer
         )
-        if traced or (max_drop_frac is None and sig in _THROUGHPUT_AUDITED):
+        if traced or (
+            max_drop_frac is None
+            and _THROUGHPUT_AUDITED.seen(centroids, sig)
+        ):
             return qc, None
         from raft_tpu.core import logger
 
@@ -258,7 +311,7 @@ def resolve_qcap_arg(qcap, q, centroids, n_lists: int, n_probes: int,
             jnp.asarray(q, jnp.float32), centroids, n_probes
         )
         stats = probe_drop_stats(probes, n_lists, qc)
-        _THROUGHPUT_AUDITED.add(sig)
+        _THROUGHPUT_AUDITED.add(centroids, sig)
         if max_drop_frac is not None and stats["frac"] > max_drop_frac:
             qc2 = resolve_qcap(
                 probes, n_lists, nq, n_probes, max_drop_frac=max_drop_frac
@@ -355,6 +408,28 @@ def auto_qcap(q, centroids, n_lists: int, n_probes: int):
     if isinstance(probes, jax.core.Tracer):
         return qcap, None
     return qcap, probes
+
+
+def static_qcap(qcap, nq: int, n_probes: int, n_lists: int) -> int:
+    """SHAPE-ONLY qcap resolution — the warm-up (AOT) sibling of
+    :func:`resolve_qcap_arg`: ``None`` -> :func:`default_qcap`,
+    ``"throughput"`` -> :func:`throughput_qcap`, an int -> as-is. Never
+    inspects a probe map, so it needs no queries, no dispatch, and no
+    host sync — ``index.warmup(nq)`` resolves its program's qcap here and
+    hands the value back for the caller to pass explicitly on every
+    serving dispatch (the data-dependent ``qcap=None`` auto path at serve
+    time may resolve differently and would compile a second program)."""
+    from raft_tpu import errors
+
+    if qcap is None:
+        return default_qcap(nq, n_probes, n_lists)
+    if qcap == "throughput":
+        return throughput_qcap(nq, n_probes, n_lists)
+    errors.expects(
+        isinstance(qcap, (int, np.integer)) and not isinstance(qcap, bool),
+        "qcap must be an int, None, or 'throughput'; got %r", qcap,
+    )
+    return int(qcap)
 
 
 def check_candidate_pool(k: int, n_probes: int, storage: ListStorage):

@@ -62,6 +62,34 @@ class IVFFlatIndex:
     storage: ListStorage
     metric: str = dataclasses.field(metadata=dict(static=True))
 
+    def warmup(self, nq: int, *, k: int = 10, n_probes: int = 8,
+               qcap=None, list_block: int = 32,
+               stream_partials=None) -> int:
+        """Pre-compile the grouped serving program for (nq, d) float32
+        batches: one all-zeros batch is dispatched through the exact
+        serving entry and blocked on, populating the in-process jit cache
+        AND (when :func:`raft_tpu.core.enable_compilation_cache` is on)
+        the persistent compilation cache — so the first real query batch
+        pays dispatch, not trace+compile (docs/serving.md).
+
+        ``qcap`` resolves SHAPE-ONLY (:func:`...ann.common.static_qcap`:
+        ``None`` -> the 2x-mean default, ``"throughput"`` -> the 0.75x-mean
+        throughput cap, an int as-is) and the resolved value is returned:
+        pass exactly that integer on every serving dispatch — the warmed
+        program is keyed on it, and the data-dependent ``qcap=None`` auto
+        path would both host-sync and possibly compile a second program.
+        """
+        from raft_tpu.spatial.ann.common import static_qcap
+
+        qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
+        q0 = jnp.zeros((nq, self.centroids.shape[1]), jnp.float32)
+        out = ivf_flat_search_grouped(
+            self, q0, k, n_probes=n_probes, qcap=qc,
+            list_block=list_block, stream_partials=stream_partials,
+        )
+        jax.block_until_ready(out)
+        return qc
+
 
 def ivf_flat_build(x, params: IVFFlatParams = IVFFlatParams(), *,
                    metric: str = "l2") -> IVFFlatIndex:

@@ -95,6 +95,34 @@ class IVFPQIndex:
     pq_dim: int = dataclasses.field(metadata=dict(static=True))
     pq_bits: int = dataclasses.field(metadata=dict(static=True))
 
+    def warmup(self, nq: int, *, k: int = 10, n_probes: int = 8,
+               qcap=None, list_block: int = 8, refine_ratio: float = 2.0,
+               refine_dataset=None, exact_selection: bool = False,
+               approx_recall_target: float = 0.95,
+               stream_partials=None) -> int:
+        """Pre-compile the grouped serving program for (nq, d) float32
+        batches by dispatching one all-zeros batch through the exact
+        serving entry (in-process jit cache + persistent compilation
+        cache when enabled) — the PQ sibling of
+        :meth:`raft_tpu.spatial.ann.ivf_flat.IVFFlatIndex.warmup`.
+
+        Returns the shape-only-resolved qcap; pass exactly that integer
+        on serving dispatches (see IVFFlatIndex.warmup for why)."""
+        from raft_tpu.spatial.ann.common import static_qcap
+
+        qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
+        q0 = jnp.zeros((nq, self.centroids.shape[1]), jnp.float32)
+        out = ivf_pq_search_grouped(
+            self, q0, k, n_probes=n_probes, qcap=qc,
+            list_block=list_block, refine_ratio=refine_ratio,
+            refine_dataset=refine_dataset,
+            exact_selection=exact_selection,
+            approx_recall_target=approx_recall_target,
+            stream_partials=stream_partials,
+        )
+        jax.block_until_ready(out)
+        return qc
+
 
 def _cdiv_host(a: int, b: int) -> int:
     return -(-a // b)

@@ -42,4 +42,3 @@ def mesh8():
 @pytest.fixture()
 def rng_np():
     return np.random.default_rng(42)
-

@@ -1,0 +1,346 @@
+"""Serving-path surface (ISSUE r6): warmup pre-compilation, the fused
+deployment-view probe set (``expand_probe_set``), the persistent
+compilation cache wiring on ``Resources``, the weakref-keyed
+throughput-qcap audit registry, chunk-min tie semantics, and the bench
+artifact compaction helpers."""
+
+import gc
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.spatial.ann import (
+    IVFFlatParams,
+    IVFPQParams,
+    ivf_flat_build,
+    ivf_pq_build,
+)
+from raft_tpu.spatial.ann import common as ann_common
+from raft_tpu.spatial.ann.ivf_flat import (
+    _grouped_impl,
+    ivf_flat_search_grouped,
+)
+from raft_tpu.spatial.ann.ivf_pq import (
+    _pq_grouped_impl,
+    ivf_pq_search_grouped,
+)
+
+FLAT_PARAMS = IVFFlatParams(n_lists=16, kmeans_n_iters=4, seed=1)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4000, 16)).astype(np.float32)
+    q = rng.standard_normal((32, 16)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def flat_index(data):
+    return ivf_flat_build(data[0], FLAT_PARAMS)
+
+
+@pytest.fixture(scope="module")
+def comms():
+    from raft_tpu.comms import build_comms
+
+    return build_comms(jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def sharded_flat(data, comms):
+    from raft_tpu.comms import mnmg_ivf_flat_build
+
+    return mnmg_ivf_flat_build(
+        comms, data[0], FLAT_PARAMS, metric="sqeuclidean"
+    )
+
+
+# ---------------------------------------------------------------- warmup
+class TestWarmup:
+    def test_static_qcap_is_shape_only(self):
+        assert ann_common.static_qcap(None, 64, 8, 16) == \
+            ann_common.default_qcap(64, 8, 16)
+        assert ann_common.static_qcap("throughput", 64, 8, 16) == \
+            ann_common.throughput_qcap(64, 8, 16)
+        assert ann_common.static_qcap(12, 64, 8, 16) == 12
+        with pytest.raises(Exception):
+            ann_common.static_qcap(1.5, 64, 8, 16)
+        with pytest.raises(Exception):
+            ann_common.static_qcap(True, 64, 8, 16)
+
+    def test_flat_warmup_precompiles_serving_program(self, flat_index,
+                                                     data):
+        qc = flat_index.warmup(32, k=5, n_probes=4)
+        assert qc == ann_common.static_qcap(None, 32, 4, 16)
+        warmed = _grouped_impl._cache_size()
+        v, i = ivf_flat_search_grouped(
+            flat_index, data[1], 5, n_probes=4, qcap=qc
+        )
+        # the warmed program IS the serving program: the real batch must
+        # not trace or compile anything new
+        assert _grouped_impl._cache_size() == warmed
+        assert v.shape == (32, 5) and i.shape == (32, 5)
+
+    def test_pq_warmup_precompiles_serving_program(self, data):
+        pq = ivf_pq_build(data[0], IVFPQParams(
+            n_lists=16, pq_dim=4, kmeans_n_iters=4, seed=1,
+        ))
+        qc = pq.warmup(32, k=5, n_probes=4, refine_ratio=2.0)
+        warmed = _pq_grouped_impl._cache_size()
+        v, i = ivf_pq_search_grouped(
+            pq, data[1], 5, n_probes=4, qcap=qc, refine_ratio=2.0,
+        )
+        assert _pq_grouped_impl._cache_size() == warmed
+        assert v.shape == (32, 5)
+
+    def test_mnmg_flat_warmup_then_serve(self, comms, sharded_flat, data):
+        from raft_tpu.comms import mnmg_ivf_flat_search
+
+        qc = sharded_flat.warmup(comms, 32, k=5, n_probes=4)
+        v, i = mnmg_ivf_flat_search(
+            comms, sharded_flat, data[1], 5, n_probes=4, qcap=qc
+        )
+        assert v.shape == (32, 5)
+        assert bool(jnp.all(i >= 0))
+
+
+# ------------------------------------------- fused deployment-view probe
+class TestExpandProbeSet:
+    def test_far_extra_centroids_do_not_change_results(self, comms,
+                                                       sharded_flat,
+                                                       data):
+        from raft_tpu.comms import expand_probe_set, mnmg_ivf_flat_search
+
+        _, q = data
+        rng = np.random.default_rng(11)
+        far = (1e4 + rng.standard_normal((64, 16))).astype(np.float32)
+        eidx = expand_probe_set(sharded_flat, far)
+        assert eidx.centroids.shape[0] == \
+            sharded_flat.centroids.shape[0] + 64
+        assert int(eidx.owner[-1]) == -1
+        v0, i0 = mnmg_ivf_flat_search(
+            comms, sharded_flat, q, 5, n_probes=4, qcap=8
+        )
+        # the fused program probes the deployment-scale set; far-away
+        # unowned centroids are never in any query's top probes, so the
+        # shard's answers are unchanged
+        v1, i1 = mnmg_ivf_flat_search(comms, eidx, q, 5, n_probes=4,
+                                      qcap=8)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(v0), np.asarray(v1),
+                                   rtol=1e-6)
+
+    def test_donated_queries_dispatch(self, comms, sharded_flat, data):
+        from raft_tpu.comms import mnmg_ivf_flat_search
+
+        _, q = data
+        v0, i0 = mnmg_ivf_flat_search(
+            comms, sharded_flat, q, 5, n_probes=4, qcap=8
+        )
+        # serving mode: fresh buffer per dispatch, donated to the runtime
+        v1, i1 = mnmg_ivf_flat_search(
+            comms, sharded_flat, jnp.asarray(q), 5, n_probes=4, qcap=8,
+            donate_queries=True,
+        )
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_dimension_mismatch_rejected(self, sharded_flat):
+        from raft_tpu.comms import expand_probe_set
+
+        with pytest.raises(Exception):
+            expand_probe_set(sharded_flat, np.zeros((4, 7), np.float32))
+
+
+# ------------------------------------------- persistent compilation cache
+class TestCompilationCache:
+    def test_resources_arg_enables_and_populates(self, tmp_path):
+        from raft_tpu import compat
+        from raft_tpu.core import (
+            Resources,
+            compilation_cache_dir,
+            enable_compilation_cache,
+        )
+        from raft_tpu.core import resources as resources_mod
+
+        cache = str(tmp_path / "xla_cache")
+        # the cache is process-global config: capture the pre-test state
+        # (CI runs the suite with its own cache dir exported) so teardown
+        # RESTORES it — hardcoding None here would silently disable the
+        # persistent cache for every later test in this process
+        prior = {
+            "jax_compilation_cache_dir":
+                jax.config.jax_compilation_cache_dir,
+            "jax_persistent_cache_min_compile_time_secs":
+                jax.config.jax_persistent_cache_min_compile_time_secs,
+            "jax_persistent_cache_min_entry_size_bytes":
+                jax.config.jax_persistent_cache_min_entry_size_bytes,
+        }
+        prior_enabled = resources_mod._cache_dir_enabled
+        try:
+            Resources(compilation_cache_dir=cache)
+            assert compilation_cache_dir() == cache
+
+            @jax.jit
+            def f(x):
+                return x * 2.0 + 1.0
+
+            f(jnp.arange(128.0)).block_until_ready()
+            n_files = sum(len(fs) for _, _, fs in os.walk(cache))
+            assert n_files > 0
+            # idempotent re-enable (the serving bootstrap path calls it
+            # once per Resources construction)
+            enable_compilation_cache(cache)
+            assert compilation_cache_dir() == cache
+        finally:
+            for name, val in prior.items():
+                jax.config.update(name, val)
+            compat.compilation_cache_reset()
+            resources_mod._cache_dir_enabled = prior_enabled
+
+
+# -------------------------------------- weakref-keyed throughput audit
+class TestThroughputAuditRegistry:
+    def test_registry_weakref_evicts_dead_entries(self):
+        reg = ann_common._AuditRegistry()
+        a = jnp.arange(8.0)
+        sig = (16, 4, 8, 64)
+        reg.add(a, sig)
+        assert reg.seen(a, sig)
+        assert not reg.seen(a, (1, 1, 1, 1))
+        del a
+        gc.collect()
+        assert not reg._by_id
+
+    def test_rebuilt_same_shape_index_is_reaudited(self, data,
+                                                   monkeypatch):
+        x, q = data
+        calls = []
+        orig = ann_common.probe_drop_stats
+
+        def counting(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(ann_common, "probe_drop_stats", counting)
+
+        def build_and_search():
+            idx = ivf_flat_build(x, FLAT_PARAMS)
+            ivf_flat_search_grouped(idx, q, 5, n_probes=4,
+                                    qcap="throughput")
+            return idx
+
+        idx = build_and_search()
+        n_first = len(calls)
+        assert n_first >= 1
+        # second search on the SAME index: audited once per process
+        ivf_flat_search_grouped(idx, q, 5, n_probes=4, qcap="throughput")
+        assert len(calls) == n_first
+        # free the index, rebuild at the identical shape: the audit must
+        # fire again — an id()-keyed registry can silently skip it when
+        # the new centroids array lands on the recycled id
+        del idx
+        gc.collect()
+        build_and_search()
+        assert len(calls) == 2 * n_first
+
+
+# --------------------------------------------- chunk-min tie semantics
+class TestChunkMinTies:
+    def test_duplicated_centroid_rows_value_multiset_matches_topk(self):
+        # duplicated centroid rows (what max_list_cap splitting creates)
+        # make exact distance ties; chunk-min may order ties differently
+        # than lax.top_k's lowest-index tiebreak, but the selected VALUE
+        # multiset must match exactly (docs/ivf_scale.md)
+        rng = np.random.default_rng(5)
+        base = rng.standard_normal((256, 8)).astype(np.float32)
+        cents = np.repeat(base, 8, axis=0)                 # (2048, 8)
+        q = rng.standard_normal((6, 8)).astype(np.float32)
+        d2 = (
+            (q ** 2).sum(1)[:, None] + (cents ** 2).sum(1)[None, :]
+            - 2.0 * q @ cents.T
+        ).astype(np.float32)
+        k = 10
+        from raft_tpu.spatial.selection import chunk_min_select_k
+
+        # the chunk path must actually engage (not the top_k fallback)
+        assert d2.shape[1] % 128 == 0 and d2.shape[1] // 128 >= k
+        v, i = chunk_min_select_k(jnp.asarray(d2), k)
+        tv, _ = jax.lax.top_k(-jnp.asarray(d2), k)
+        v, i, tv = np.asarray(v), np.asarray(i), -np.asarray(tv)
+        np.testing.assert_array_equal(np.sort(v, axis=1),
+                                      np.sort(tv, axis=1))
+        # returned indices address the returned values
+        np.testing.assert_array_equal(
+            np.take_along_axis(d2, i, axis=1), v
+        )
+
+
+# --------------------------------------------- bench artifact compaction
+class TestBenchArtifact:
+    @pytest.fixture(scope="class")
+    def benchtop(self):
+        import importlib.util
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "benchtop", os.path.join(root, "bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_compact_drops_prose_and_rounds(self, benchtop):
+        row = {
+            "metric": "m", "value": 123.456, "unit": "QPS",
+            "spread": 0.2, "note": "prose", "qcap": "throughput (=24)",
+            "vs_prev_qcap8_qps": 1.01,
+            "extras": [{
+                "metric": "e", "value": 10.12345, "bf16_note": "x",
+                "rows": [{"engine": "fused_knn", "nq": 1,
+                          "p50_ms": 0.123456, "qcap": 8}],
+            }],
+        }
+        c = benchtop._compact(row)
+        assert "note" not in c and "qcap" not in c
+        assert c["value"] == 123.5
+        assert c["vs_prev_qcap8_qps"] == 1.01
+        sub = c["extras"][0]
+        assert "bf16_note" not in sub
+        assert sub["rows"][0] == {"engine": "fused_knn", "nq": 1,
+                                  "p50_ms": 0.1235, "qcap": 8}
+        # the whole compact line stays printable well under the driver cap
+        import json
+
+        assert len(json.dumps(c)) < 1800
+
+    def test_vs_prev_significance_stamp(self, benchtop):
+        prev = {"m": {"value": 112.0}}
+        noisy = benchtop._stamp_vs_prev(
+            {"metric": "m", "value": 118.0, "spread": 0.2}, prev
+        )
+        assert noisy["vs_prev_significant"] is False
+        clear = benchtop._stamp_vs_prev(
+            {"metric": "m", "value": 150.0, "spread": 0.05}, prev
+        )
+        assert "vs_prev_significant" not in clear
+
+
+# ------------------------------------------------- latency sweep surface
+def test_serving_latency_rows_tiny_config():
+    from bench.bench_serving import serving_latency_rows
+
+    out = serving_latency_rows(
+        n=8192, d=8, k=4, n_probes=4, n_lists=8, nqs=(1, 4),
+        engines=("ivf_flat",), chain=(1, 3), escalate=0,
+    )
+    assert out["unit"] == "ms"
+    assert [r["nq"] for r in out["rows"]] == [1, 4]
+    for r in out["rows"]:
+        assert r["engine"] == "ivf_flat"
+        assert ("p50_ms" in r) or ("error" in r)
+        assert "qcap" in r
