@@ -55,10 +55,12 @@ __all__ = [
     "matrix",
     "pylibraft",
     "random",
+    "resilience",
     "sparse",
     "spatial",
     "spectral",
     "stats",
+    "testing",
     "utils",
     "__version__",
 ]
@@ -66,7 +68,8 @@ __all__ = [
 _SUBMODULES = {
     "analysis", "cache", "cluster", "comms", "compat", "core", "distance",
     "errors", "label", "lap", "linalg", "matrix", "native", "pylibraft",
-    "random", "sparse", "spatial", "spectral", "stats", "utils",
+    "random", "resilience", "sparse", "spatial", "spectral", "stats",
+    "testing", "utils",
 }
 
 

@@ -27,6 +27,7 @@ from raft_tpu.comms.mnmg_ivf import (
     mnmg_ivf_pq_build_distributed,
     mnmg_ivf_pq_search,
     place_index,
+    reshard_index,
     shard_rows,
 )
 from raft_tpu.comms.mnmg_ivf_flat import (
@@ -60,6 +61,7 @@ __all__ = [
     "mnmg_ivf_flat_build_distributed",
     "mnmg_ivf_flat_search",
     "place_index",
+    "reshard_index",
     "shard_rows",
     "ring_knn",
     "ring_pairwise_distance",

@@ -112,6 +112,12 @@ class AxisComms:
         """Variable-size allgather (comms.hpp:320). Static-shape TPU form:
         each rank contributes a (max_count, ...) slot plus its valid count;
         returns (stacked (size, max_count, ...), counts (size,))."""
+        errors.expects(
+            x.shape[0] <= max_count,
+            "allgatherv: contribution has %d rows > max_count=%d — every "
+            "rank's slot is padded TO max_count, it cannot shrink to it",
+            x.shape[0], max_count,
+        )
         pad = [(0, max_count - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
         slot = jnp.pad(x, pad)
         return (
@@ -129,9 +135,15 @@ class AxisComms:
     def reducescatter(self, x, op=ReduceOp.SUM, tiled: bool = False):
         """Each rank gets its slice of the reduction (comms.hpp:401)."""
         op = _resolve_op(op)
+        sz = self.get_size()
+        errors.expects(
+            x.shape[0] % sz == 0,
+            "reducescatter: leading dim %d not divisible by the "
+            "communicator size %d — each rank's slice must be uniform",
+            x.shape[0], sz,
+        )
         if op != ReduceOp.SUM:
             g = self.allreduce(x, op)
-            sz = self.get_size()
             shard = x.shape[0] // sz
             return lax.dynamic_slice_in_dim(g, self.get_rank() * shard, shard)
         return lax.psum_scatter(x, self.axis, tiled=tiled)
@@ -228,29 +240,37 @@ class P2PBatch:
 
         Validates the send/recv sets match, as the reference's waitall
         contract implies (an unmatched tag hangs a UCX endpoint; here it
-        is an immediate error)."""
-        send_keys = [(s, d, t) for s, d, t, _ in self._sends]
-        sends = set(send_keys)
-        recvs = set(self._recvs)
-        # duplicate (src, dst, tag) keys are ambiguous — the result dict
-        # could only hold one of them (the UCX reference disambiguates by
-        # distinct tags; require the same here)
-        errors.expects(
-            len(send_keys) == len(sends),
-            "p2p waitall: duplicate (src, dst, tag) sends %s — use distinct "
-            "tags per in-flight transfer",
-            sorted(k for k in sends if send_keys.count(k) > 1),
-        )
-        errors.expects(
-            len(self._recvs) == len(recvs),
-            "p2p waitall: duplicate (src, dst, tag) recvs %s",
-            sorted(k for k in recvs if self._recvs.count(k) > 1),
-        )
-        errors.expects(
-            sends == recvs,
-            "p2p waitall: unmatched transfers (sends-only %s, recvs-only %s)",
-            sorted(sends - recvs), sorted(recvs - sends),
-        )
+        is an immediate error). A validation failure CLEARS the recorded
+        state (as completion does), so a corrected retry on the same
+        batch records from scratch instead of colliding with the stale
+        entries of the rejected attempt."""
+        try:
+            send_keys = [(s, d, t) for s, d, t, _ in self._sends]
+            sends = set(send_keys)
+            recvs = set(self._recvs)
+            # duplicate (src, dst, tag) keys are ambiguous — the result
+            # dict could only hold one of them (the UCX reference
+            # disambiguates by distinct tags; require the same here)
+            errors.expects(
+                len(send_keys) == len(sends),
+                "p2p waitall: duplicate (src, dst, tag) sends %s — use "
+                "distinct tags per in-flight transfer",
+                sorted(k for k in sends if send_keys.count(k) > 1),
+            )
+            errors.expects(
+                len(self._recvs) == len(recvs),
+                "p2p waitall: duplicate (src, dst, tag) recvs %s",
+                sorted(k for k in recvs if self._recvs.count(k) > 1),
+            )
+            errors.expects(
+                sends == recvs,
+                "p2p waitall: unmatched transfers (sends-only %s, "
+                "recvs-only %s)",
+                sorted(sends - recvs), sorted(recvs - sends),
+            )
+        except Exception:
+            self._sends, self._recvs = [], []
+            raise
         rank = self._comms.get_rank()
         out = {}
         by_tag = {}

@@ -34,7 +34,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import typing
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +44,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raft_tpu import compat, errors
 from raft_tpu.comms.comms import Comms
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+from raft_tpu.resilience.degraded import (
+    PartialSearchResult,
+    mask_invalid_rows,
+    probe_coverage,
+    resolve_shard_mask,
+    sanitize_query_rows,
+)
 from raft_tpu.spatial.ann.common import (
     ListStorage,
     coarse_probe,
@@ -63,7 +69,7 @@ from raft_tpu.spatial.selection import select_k
 __all__ = [
     "MnmgIVFPQIndex", "expand_probe_set", "mnmg_ivf_pq_build",
     "mnmg_ivf_pq_build_distributed", "mnmg_ivf_pq_search", "place_index",
-    "shard_rows",
+    "reshard_index", "shard_rows",
 ]
 
 
@@ -99,7 +105,8 @@ class MnmgIVFPQIndex:
                n_probes: int = 8, qcap=None, list_block: int = 8,
                refine_ratio: float = 2.0, exact_selection: bool = True,
                approx_recall_target: float = 0.95,
-               donate_queries: bool = False) -> int:
+               donate_queries: bool = False,
+               shard_mask=None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches: one all-zeros batch runs through
         :func:`mnmg_ivf_pq_search` and is blocked on, so the first real
@@ -109,7 +116,11 @@ class MnmgIVFPQIndex:
         Returns the shape-only-resolved qcap
         (:func:`raft_tpu.spatial.ann.common.static_qcap`); pass exactly
         that integer (and the same ``donate_queries``) on serving
-        dispatches — the compiled program is keyed on both."""
+        dispatches — the compiled program is keyed on both. Pass
+        ``shard_mask=True`` to warm the RESILIENT variant instead (the
+        ``shard_mask=``/``PartialSearchResult`` program —
+        docs/robustness.md); the mask itself is a runtime input, so one
+        warm-up covers every later health state."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -119,7 +130,7 @@ class MnmgIVFPQIndex:
             list_block=list_block, refine_ratio=refine_ratio,
             exact_selection=exact_selection,
             approx_recall_target=approx_recall_target,
-            donate_queries=donate_queries,
+            donate_queries=donate_queries, shard_mask=shard_mask,
         )
         jax.block_until_ready(out)
         return qc
@@ -141,6 +152,36 @@ def _cached_program(key, make):
             _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
         fn = _PROGRAM_CACHE[key] = make()
     return fn
+
+
+def _slab_height(loads) -> int:
+    """Bucketed per-rank slab height (n_pad) shared by the distributed
+    builds and :func:`reshard_index`: the raw max-load is data-dependent,
+    so a same-shape rebuild (or a reshard) would shift n_pad by a handful
+    of rows and recompile BOTH the assembly program and every search
+    program keyed on it; rounding up to a coarse bucket (<= ~6% slab
+    padding) keeps the statics — and the compiled programs — stable."""
+    raw_npad = max(int(np.max(loads)), 1)
+    bucket = 256 if raw_npad < (1 << 17) else 4096
+    return _cdiv_host(raw_npad, bucket) * bucket
+
+
+def _rank_slab_maps(owner, local_id, sizes, cents, n_ranks: int,
+                    nl_pad: int, d: int):
+    """Per-rank (offsets, sizes, centroids) slabs from a list→rank
+    assignment (owner -1 = unowned, left out of every slab). The single
+    layout authority for builds AND reshards — both must produce
+    byte-identical slab geometry for a given assignment."""
+    offs_sh = np.zeros((n_ranks, nl_pad + 1), np.int32)
+    szs_sh = np.zeros((n_ranks, nl_pad), np.int32)
+    lcents_sh = np.zeros((n_ranks, nl_pad, d), np.float32)
+    for r in range(n_ranks):
+        mine = np.nonzero(owner == r)[0]
+        lid = local_id[mine]
+        szs_sh[r, lid] = sizes[mine]
+        offs_sh[r] = np.concatenate([[0], np.cumsum(szs_sh[r])])
+        lcents_sh[r, lid] = cents[mine]
+    return offs_sh, szs_sh, lcents_sh
 
 
 def _lpt_assign(sizes: np.ndarray, n_ranks: int):
@@ -487,27 +528,12 @@ def _exchange_and_assemble(
         ssz = sizes
 
     owner, local_id, loads, lists_per = _lpt_assign(ssz, Pn)
-    # bucket the slab height: raw max-load is data-dependent, so a
-    # same-shape rebuild (or an incremental re-ingest) would shift n_pad
-    # by a handful of rows and recompile BOTH the assembly program and
-    # every search program keyed on it; rounding up to a coarse bucket
-    # (<= ~6% slab padding) keeps the statics — and the compiled
-    # programs — stable across rebuilds
-    raw_npad = max(int(loads.max()), 1)
-    bucket = 256 if raw_npad < (1 << 17) else 4096
-    n_pad = _cdiv_host(raw_npad, bucket) * bucket
+    n_pad = _slab_height(loads)
     nl_pad = int(lists_per.max()) + 1          # +1 empty sentinel list
     max_list = max(int(ssz.max()), 1)
-
-    offs_sh = np.zeros((Pn, nl_pad + 1), np.int32)
-    szs_sh = np.zeros((Pn, nl_pad), np.int32)
-    lcents_sh = np.zeros((Pn, nl_pad, d), np.float32)
-    for r in range(Pn):
-        mine = np.nonzero(owner == r)[0]
-        lid = local_id[mine]
-        szs_sh[r, lid] = ssz[mine]
-        offs_sh[r] = np.concatenate([[0], np.cumsum(szs_sh[r])])
-        lcents_sh[r, lid] = cents_np[mine]
+    offs_sh, szs_sh, lcents_sh = _rank_slab_maps(
+        owner, local_id, ssz, cents_np, Pn, nl_pad, d
+    )
 
     # ---- phase 4a: device-side routing. Each row's GLOBAL within-list
     # rank (a per-rank prefix over the phase-2 count matrix + a local
@@ -697,19 +723,104 @@ def field_sharding(comms: Comms, name: str, ndim: int):
     return NamedSharding(comms.mesh, P())
 
 
+def reshard_index(comms: Comms, index):
+    """Re-partition a list-sharded index built for a DIFFERENT mesh size
+    onto ``comms`` — the recovery path after losing (or regaining) ranks
+    (docs/robustness.md): reload the checkpoint, re-shard onto whatever
+    mesh survives, keep serving.
+
+    Host-side O(n) slab rebuild: every list's rows are copied from their
+    old owner's contiguous slab segment into a freshly LPT-balanced
+    layout for the new rank count (``_lpt_assign`` — the same greedy
+    placement the builds use, so a reshard is exactly as balanced as a
+    rebuild), with the same slab-height bucketing so the search statics
+    stay coarse-stable. Quantizers, global ids, per-list contents, and
+    ``max_list`` are unchanged — search results are identical to the
+    original mesh's (tests/test_resilience.py asserts it). ``owner=-1``
+    probe-set extras (:func:`expand_probe_set`) stay unowned. Returns a
+    host-resident index; :func:`place_index` (which calls this
+    automatically on a size mismatch) handles device placement."""
+    Pn = comms.size
+    owner = np.asarray(index.owner)
+    local_id = np.asarray(index.local_id)
+    szs = np.asarray(index.list_sizes)
+    offs = np.asarray(index.list_offsets)
+    sids = np.asarray(index.sorted_ids)
+    cents = np.asarray(index.centroids, np.float32)
+    d = cents.shape[1]
+    codes = getattr(index, "codes_sorted", None)
+    codes = None if codes is None else np.asarray(codes)
+    vecs = (
+        None if index.vectors_sorted is None
+        else np.asarray(index.vectors_sorted)
+    )
+    nl_g = owner.shape[0]
+    real = np.nonzero(owner >= 0)[0]
+    errors.expects(
+        real.size > 0, "reshard_index: index owns no lists (owner all -1)"
+    )
+    sizes = np.zeros(nl_g, np.int64)
+    sizes[real] = szs[owner[real], local_id[real]]
+    new_owner = np.full(nl_g, -1, np.int32)
+    new_lid = np.zeros(nl_g, np.int32)
+    o_r, l_r, loads, lists_per = _lpt_assign(sizes[real], Pn)
+    new_owner[real] = o_r
+    new_lid[real] = l_r
+    # the build's shared layout helpers: identical bucketing and slab
+    # geometry, so statics stay stable across repeated reshards
+    n_pad = _slab_height(loads)
+    nl_pad = int(lists_per.max()) + 1          # +1 empty sentinel list
+    offs_sh, szs_sh, lcents_sh = _rank_slab_maps(
+        new_owner, new_lid, sizes, cents, Pn, nl_pad, d
+    )
+
+    new_sids = np.zeros((Pn, n_pad), np.int32)
+    new_codes = (
+        None if codes is None
+        else np.zeros((Pn, n_pad + 1, codes.shape[2]), codes.dtype)
+    )
+    new_vecs = (
+        None if vecs is None
+        else np.zeros((Pn, n_pad + 1, vecs.shape[2]), vecs.dtype)
+    )
+    for l in real.tolist():
+        sz = int(sizes[l])
+        if sz == 0:
+            continue
+        ro, jo = int(owner[l]), int(local_id[l])
+        rn, jn = int(new_owner[l]), int(new_lid[l])
+        src = slice(int(offs[ro, jo]), int(offs[ro, jo]) + sz)
+        dst = slice(int(offs_sh[rn, jn]), int(offs_sh[rn, jn]) + sz)
+        new_sids[rn, dst] = sids[ro, src]
+        if new_codes is not None:
+            new_codes[rn, dst] = codes[ro, src]
+        if new_vecs is not None:
+            new_vecs[rn, dst] = vecs[ro, src]
+
+    kw = dict(
+        owner=new_owner, local_id=new_lid, local_cents=lcents_sh,
+        sorted_ids=new_sids, list_offsets=offs_sh, list_sizes=szs_sh,
+        n_pad=n_pad, nl_pad=nl_pad,
+    )
+    if new_codes is not None:
+        kw["codes_sorted"] = new_codes
+    if new_vecs is not None:
+        kw["vectors_sorted"] = new_vecs
+    return dataclasses.replace(index, **kw)
+
+
 def place_index(comms: Comms, index):
     """(Re-)place a sharded index's arrays onto a comms mesh: slabs shard
     over the mesh axis, quantizers and ownership maps replicate. Works on
     any sharded index dataclass (MnmgIVFPQIndex, MnmgIVFFlatIndex); used
     by the builds themselves and after
-    :func:`raft_tpu.spatial.ann.load_index`. The index must have been
-    built for the same mesh size (its slab leading axis)."""
+    :func:`raft_tpu.spatial.ann.load_index`. An index built for a
+    DIFFERENT mesh size is re-partitioned first via
+    :func:`reshard_index` — the recovery path after losing a rank
+    (docs/robustness.md)."""
     n_ranks = index.sorted_ids.shape[0]
-    errors.expects(
-        n_ranks == comms.size,
-        "place_index: index built for %d ranks, mesh has %d",
-        n_ranks, comms.size,
-    )
+    if n_ranks != comms.size:
+        index = reshard_index(comms, index)
     kw = {}
     for f in dataclasses.fields(type(index)):
         v = getattr(index, f.name)
@@ -724,7 +835,7 @@ def place_index(comms: Comms, index):
 @functools.lru_cache(maxsize=32)
 def _cached_search(
     mesh: jax.sharding.Mesh, axis: str, store_raw: bool, statics: tuple,
-    donate: bool = False,
+    donate: bool = False, degraded: bool = False,
 ):
     """Compile one shard_map search program per (mesh, static-config).
 
@@ -735,14 +846,27 @@ def _cached_search(
 
     ``donate=True`` donates the query buffer to the runtime (serving
     dispatch: the output may alias the input's memory and no copy of the
-    batch survives the call — the caller must not reuse the array)."""
+    batch survives the call — the caller must not reuse the array).
+
+    ``degraded=True`` compiles the resilient serving variant: an extra
+    ``alive`` (P,) int32 RUNTIME input (so health flips never recompile)
+    masks a down shard's contribution to +inf before the merge,
+    non-finite query rows are neutralized in-graph, and the program
+    returns ``(dists, ids, coverage, row_valid)``
+    (raft_tpu.resilience.degraded; docs/robustness.md)."""
     (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
      approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
 
-    def body(cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
-             loffs, lszs, q):
+    def body(*opnds):
+        if degraded:
+            (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
+             loffs, lszs, q, alive) = opnds
+        else:
+            (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
+             loffs, lszs, q) = opnds
+            alive = None
         # sharded slabs arrive as (1, ...) blocks — drop the mesh axis
         lcents, codes_s, sids = lcents[0], codes_s[0], sids[0]
         loffs, lszs = loffs[0], lszs[0]
@@ -750,10 +874,14 @@ def _cached_search(
         rank = lax.axis_index(ax.axis)
 
         qf = q.astype(jnp.float32)
+        row_valid = None
+        if degraded:
+            qf, row_valid = sanitize_query_rows(qf)
         # replicated compute: identical global probes on every chip —
         # queries never move, only the (nq, k) results do
         probes_g, _ = coarse_probe(qf, cents, n_probes)      # (nq, p)
-        own = owner[probes_g] == rank
+        probe_owner = owner[probes_g]                        # (nq, p)
+        own = probe_owner == rank
         lp = jnp.where(
             own, local_id[probes_g], jnp.int32(nl_pad - 1)   # sentinel list
         )
@@ -778,6 +906,10 @@ def _cached_search(
             shard, qf, k, n_probes, qcap, list_block, refine_ratio,
             None, lp, exact_selection, approx_recall_target,
         )
+        if degraded:
+            # a down shard contributes +inf distances to the merge — its
+            # candidates can never displace a live shard's
+            vals = jnp.where(alive[rank] > 0, vals, jnp.inf)
         # k-way merge: one small all_gather pair + select_k
         pd = ax.allgather(vals)                              # (P, nq, k)
         pi = ax.allgather(gids)
@@ -786,6 +918,10 @@ def _cached_search(
         flat_i = pi.transpose(1, 0, 2).reshape(nq, -1)
         md, mi = select_k(flat_d, k, indices=flat_i)
         mi = jnp.where(jnp.isfinite(md), mi, -1)
+        if degraded:
+            cov = probe_coverage(probe_owner, alive, row_valid)
+            md, mi = mask_invalid_rows(md, mi, row_valid)
+            return md, mi, cov, row_valid
         return md, mi
 
     sharded = P(comms.axis, None, None)
@@ -797,11 +933,14 @@ def _cached_search(
         sharded if store_raw else P(None, None, None),
         sharded2, sharded2, sharded2, rep2,
     )
-    sm = comms.shard_map(
-        body, in_specs=in_specs, out_specs=(rep2, rep2)
-    )
-    # queries are the last positional argument; donation frees/aliases the
-    # batch buffer for the outputs (index slabs are never donated)
+    out_specs = (rep2, rep2)
+    if degraded:
+        in_specs = in_specs + (P(None),)
+        out_specs = (rep2, rep2, P(None), P(None))
+    sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
+    # queries are positional argument 10 (the alive mask, when present,
+    # follows them); donation frees/aliases the batch buffer for the
+    # outputs (index slabs are never donated)
     return jax.jit(sm, donate_argnums=(10,) if donate else ())
 
 
@@ -852,7 +991,8 @@ def mnmg_ivf_pq_search(
     approx_recall_target: float = 0.95,
     qcap_max_drop_frac: typing.Optional[float] = None,
     donate_queries: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
+    shard_mask=None,
+):
     """Distributed grouped ADC search over a list-sharded index.
 
     Returns (exact-refined squared L2 distances, GLOBAL row ids), both
@@ -883,6 +1023,16 @@ def mnmg_ivf_pq_search(
     serving-dispatch mode, paired with an explicit integer ``qcap`` and
     :meth:`MnmgIVFPQIndex.warmup` so the dispatch is fully async with no
     host-side sync or trace (docs/serving.md).
+
+    ``shard_mask`` selects the RESILIENT serving variant
+    (docs/robustness.md): pass a per-rank validity mask — a
+    :class:`raft_tpu.resilience.ShardHealth`, an array-like of (P,)
+    truth values, or ``True`` for all-up — and the search degrades
+    instead of failing: a down shard contributes +inf distances,
+    non-finite query rows are neutralized in-graph, and the return type
+    becomes :class:`raft_tpu.resilience.PartialSearchResult` carrying
+    per-query ``coverage`` and the ``partial`` flag. The mask is a
+    runtime input: flipping a rank's health never recompiles.
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -908,15 +1058,24 @@ def mnmg_ivf_pq_search(
         approx_recall_target, index.pq_dim, index.pq_bits, index.n_pad,
         index.nl_pad, index.max_list,
     )
+    degraded = shard_mask is not None
     fn = _cached_search(
-        comms.mesh, comms.axis, store_raw, statics, donate_queries
+        comms.mesh, comms.axis, store_raw, statics, donate_queries,
+        degraded,
     )
     vecs = (
         index.vectors_sorted if store_raw
         else jnp.zeros((comms.size, 1, 1), jnp.float32)
     )
-    return fn(
+    args = (
         index.centroids, index.codebooks, index.owner, index.local_id,
         index.local_cents, index.codes_sorted, vecs, index.sorted_ids,
         index.list_offsets, index.list_sizes, q,
+    )
+    if not degraded:
+        return fn(*args)
+    alive = resolve_shard_mask(shard_mask, comms.size)
+    md, mi, cov, rv = fn(*args, jnp.asarray(alive))
+    return PartialSearchResult(
+        distances=md, ids=mi, coverage=cov, row_valid=rv
     )

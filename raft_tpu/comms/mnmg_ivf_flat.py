@@ -33,7 +33,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import typing
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +43,13 @@ from jax.sharding import PartitionSpec as P
 from raft_tpu import compat, errors
 from raft_tpu.cluster.kmeans import kmeans_predict
 from raft_tpu.comms.comms import Comms
+from raft_tpu.resilience.degraded import (
+    PartialSearchResult,
+    mask_invalid_rows,
+    probe_coverage,
+    resolve_shard_mask,
+    sanitize_query_rows,
+)
 from raft_tpu.comms.mnmg_ivf import (
     _cached_program,
     _cdiv_host,
@@ -95,14 +101,17 @@ class MnmgIVFFlatIndex:
 
     def warmup(self, comms: "Comms", nq: int, *, k: int = 10,
                n_probes: int = 8, qcap=None, list_block: int = 32,
-               donate_queries: bool = False) -> int:
+               donate_queries: bool = False, shard_mask=None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through
         :func:`mnmg_ivf_flat_search` — the Flat sibling of
         :meth:`raft_tpu.comms.mnmg_ivf.MnmgIVFPQIndex.warmup`.
 
         Returns the shape-only-resolved qcap; pass exactly that integer
-        (and the same ``donate_queries``) on serving dispatches."""
+        (and the same ``donate_queries``) on serving dispatches. Pass
+        ``shard_mask=True`` to warm the resilient variant instead
+        (docs/robustness.md); the mask is a runtime input, so one
+        warm-up covers every later health state."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -110,6 +119,7 @@ class MnmgIVFFlatIndex:
         out = mnmg_ivf_flat_search(
             comms, self, q0, k, n_probes=n_probes, qcap=qc,
             list_block=list_block, donate_queries=donate_queries,
+            shard_mask=shard_mask,
         )
         jax.block_until_ready(out)
         return qc
@@ -245,25 +255,39 @@ def mnmg_ivf_flat_build_distributed(
 @functools.lru_cache(maxsize=32)
 def _cached_search(
     mesh: jax.sharding.Mesh, axis: str, statics: tuple,
-    donate: bool = False,
+    donate: bool = False, degraded: bool = False,
 ):
     """Compile one shard_map search program per (mesh, static-config);
     keyed on value-hashable (mesh, axis), not the Comms identity.
     ``donate=True`` donates the query buffer (serving dispatch; the
-    caller must not reuse the array after the call)."""
+    caller must not reuse the array after the call). ``degraded=True``
+    compiles the resilient variant — an ``alive`` (P,) runtime mask,
+    +inf contributions from down shards, in-graph query sanitization,
+    and (dists, ids, coverage, row_valid) outputs (docs/robustness.md)."""
     (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
 
-    def body(cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs, q):
+    def body(*opnds):
+        if degraded:
+            (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
+             q, alive) = opnds
+        else:
+            (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
+             q) = opnds
+            alive = None
         lcents, vecs, sids = lcents[0], vecs_s[0], sids[0]
         loffs, lszs = loffs[0], lszs[0]
         rank = lax.axis_index(ax.axis)
 
         qf = q.astype(jnp.float32)
+        row_valid = None
+        if degraded:
+            qf, row_valid = sanitize_query_rows(qf)
         # replicated compute: identical global probes on every chip
         probes_g, _ = coarse_probe(qf, cents, n_probes)      # (nq, p)
-        own = owner[probes_g] == rank
+        probe_owner = owner[probes_g]                        # (nq, p)
+        own = probe_owner == rank
         lp = jnp.where(
             own, local_id[probes_g], jnp.int32(nl_pad - 1)   # sentinel list
         )
@@ -285,6 +309,9 @@ def _cached_search(
         vals, gids = _grouped_impl(
             shard, qf, k, n_probes, qcap, list_block, probes=lp,
         )
+        if degraded:
+            # a down shard contributes +inf distances to the merge
+            vals = jnp.where(alive[rank] > 0, vals, jnp.inf)
         pd = ax.allgather(vals)                              # (P, nq, k)
         pi = ax.allgather(gids)
         nq = q.shape[0]
@@ -292,6 +319,10 @@ def _cached_search(
         flat_i = pi.transpose(1, 0, 2).reshape(nq, -1)
         md, mi = select_k(flat_d, k, indices=flat_i)
         mi = jnp.where(jnp.isfinite(md), mi, -1)
+        if degraded:
+            cov = probe_coverage(probe_owner, alive, row_valid)
+            md, mi = mask_invalid_rows(md, mi, row_valid)
+            return md, mi, cov, row_valid
         return md, mi
 
     sharded3 = P(comms.axis, None, None)
@@ -301,8 +332,13 @@ def _cached_search(
         rep2, P(None), P(None),
         sharded3, sharded3, sharded2, sharded2, sharded2, rep2,
     )
-    sm = comms.shard_map(body, in_specs=in_specs, out_specs=(rep2, rep2))
-    # queries are the last positional argument (donation: serving mode)
+    out_specs = (rep2, rep2)
+    if degraded:
+        in_specs = in_specs + (P(None),)
+        out_specs = (rep2, rep2, P(None), P(None))
+    sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
+    # queries are positional argument 8; the alive mask, when present,
+    # follows them (donation: serving mode)
     return jax.jit(sm, donate_argnums=(8,) if donate else ())
 
 
@@ -312,7 +348,8 @@ def mnmg_ivf_flat_search(
     list_block: int = 32,
     qcap_max_drop_frac: typing.Optional[float] = None,
     donate_queries: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
+    shard_mask=None,
+):
     """Distributed grouped EXACT search over a list-sharded IVF-Flat
     index. Returns (distances, GLOBAL row ids), both (nq, k) replicated
     on every chip; distances are sqrt'd for ``metric='l2'`` (squared for
@@ -331,6 +368,14 @@ def mnmg_ivf_flat_search(
     its memory; the caller must not touch the array after the call) —
     the serving-dispatch mode, paired with an explicit integer ``qcap``
     and :meth:`MnmgIVFFlatIndex.warmup` (docs/serving.md).
+
+    ``shard_mask`` selects the RESILIENT serving variant exactly as in
+    :func:`raft_tpu.comms.mnmg_ivf.mnmg_ivf_pq_search`: a per-rank
+    validity mask (ShardHealth | array | True) degrades the search —
+    down shards contribute +inf, bad query rows are neutralized — and
+    the return type becomes
+    :class:`raft_tpu.resilience.PartialSearchResult` with per-query
+    ``coverage`` and the ``partial`` flag (docs/robustness.md).
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -356,12 +401,26 @@ def mnmg_ivf_flat_search(
         k, n_probes, qcap, list_block, index.n_pad, index.nl_pad,
         index.max_list,
     )
-    fn = _cached_search(comms.mesh, comms.axis, statics, donate_queries)
-    vals, ids = fn(
+    degraded = shard_mask is not None
+    fn = _cached_search(
+        comms.mesh, comms.axis, statics, donate_queries, degraded
+    )
+    args = (
         index.centroids, index.owner, index.local_id, index.local_cents,
         index.vectors_sorted, index.sorted_ids, index.list_offsets,
         index.list_sizes, q,
     )
+    if not degraded:
+        vals, ids = fn(*args)
+        if index.metric == "l2":
+            vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+        return vals, ids
+    alive = resolve_shard_mask(shard_mask, comms.size)
+    md, mi, cov, rv = fn(*args, jnp.asarray(alive))
     if index.metric == "l2":
-        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
-    return vals, ids
+        # sqrt after the merge, exactly as the healthy path; +inf slots
+        # (down shards, invalid rows) stay +inf
+        md = jnp.sqrt(jnp.maximum(md, 0.0))
+    return PartialSearchResult(
+        distances=md, ids=mi, coverage=cov, row_valid=rv
+    )

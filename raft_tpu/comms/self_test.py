@@ -28,6 +28,7 @@ __all__ = [
     "test_collective_alltoall",
     "test_pointToPoint_simple_send_recv",
     "test_collective_comm_split",
+    "SELF_TESTS",
     "run_all_self_tests",
 ]
 
@@ -171,17 +172,23 @@ def test_collective_comm_split(comms: Comms) -> bool:
     return True
 
 
+# the canonical ordered sweep: run_all_self_tests runs it whole; the
+# serving health probe (raft_tpu.resilience.health_check) walks it one
+# collective at a time to attach per-collective timings
+SELF_TESTS = {
+    "allreduce": test_collective_allreduce,
+    "broadcast": test_collective_broadcast,
+    "reduce": test_collective_reduce,
+    "allgather": test_collective_allgather,
+    "gather": test_collective_gather,
+    "gatherv": test_collective_gatherv,
+    "reducescatter": test_collective_reducescatter,
+    "alltoall": test_collective_alltoall,
+    "sendrecv": test_pointToPoint_simple_send_recv,
+    "comm_split": test_collective_comm_split,
+}
+
+
 def run_all_self_tests(comms: Comms) -> dict:
     """Run the full round-trip suite; returns {name: bool}."""
-    return {
-        "allreduce": test_collective_allreduce(comms),
-        "broadcast": test_collective_broadcast(comms),
-        "reduce": test_collective_reduce(comms),
-        "allgather": test_collective_allgather(comms),
-        "gather": test_collective_gather(comms),
-        "gatherv": test_collective_gatherv(comms),
-        "reducescatter": test_collective_reducescatter(comms),
-        "alltoall": test_collective_alltoall(comms),
-        "sendrecv": test_pointToPoint_simple_send_recv(comms),
-        "comm_split": test_collective_comm_split(comms),
-    }
+    return {name: fn(comms) for name, fn in SELF_TESTS.items()}

@@ -96,8 +96,10 @@ class Interruptible:
 
     @classmethod
     def synchronize(cls, x, *, poll_interval_s: float = 0.001,
-                    max_poll_interval_s: float = 0.05) -> None:
-        """Cancellable wait on a jax array / pytree.
+                    max_poll_interval_s: float = 0.05,
+                    timeout_s: Optional[float] = None) -> None:
+        """Cancellable, optionally deadline-bounded wait on a jax array /
+        pytree.
 
         The exact analog of the reference's polling loop
         (interruptible.hpp:66-120: ``cudaStreamQuery`` + token check +
@@ -108,19 +110,40 @@ class Interruptible:
         as in the reference). Leaves without ``is_ready`` (plain numpy /
         scalars) are treated as ready.
 
+        ``timeout_s`` bounds the wait: if the work is still not ready
+        after that many seconds, :class:`raft_tpu.errors.RaftTimeoutError`
+        is raised (the dispatched work still completes — the deadline
+        abandons the WAIT, exactly like cancellation). Cancellation and
+        the deadline compose: the token is checked before the clock every
+        iteration, so whichever fires first wins and a cancel can never
+        be masked by an elapsed deadline. The serving path's
+        deadline/retry recipe builds on this
+        (``raft_tpu.resilience.dispatch_with_deadline``,
+        docs/robustness.md).
+
         The poll interval backs off exponentially from
         ``poll_interval_s`` toward ``max_poll_interval_s`` so a
         multi-second kernel doesn't burn a host core in 1 ms wakeups;
-        cancellation latency stays bounded by the cap.
+        cancellation (and deadline) latency stays bounded by the cap.
         """
         leaves = [
             leaf for leaf in jax.tree.leaves(x) if hasattr(leaf, "is_ready")
         ]
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
         interval = poll_interval_s
         while True:
             cls.yield_now()
             leaves = [leaf for leaf in leaves if not leaf.is_ready()]
             if not leaves:
                 return
+            if deadline is not None and time.monotonic() >= deadline:
+                from raft_tpu import errors
+
+                raise errors.RaftTimeoutError(
+                    "synchronize: dispatched work not ready within "
+                    f"{timeout_s:.3g}s ({len(leaves)} leaves pending)"
+                )
             time.sleep(interval)  # the std::this_thread::yield slot
             interval = min(interval * 2.0, max_poll_interval_s)

@@ -31,6 +31,8 @@ import numpy as np
 __all__ = [
     "RaftException",
     "RaftLogicError",
+    "RaftTimeoutError",
+    "CorruptIndexError",
     "expects",
     "fail",
     "check_matrix",
@@ -56,6 +58,33 @@ class RaftLogicError(RaftException, ValueError):
     """Analog of ``raft::logic_error`` (error.hpp:107): a precondition on
     caller-supplied arguments failed. Subclasses ValueError so existing
     ``except ValueError`` callers (and tests) keep working."""
+
+
+class RaftTimeoutError(RaftException, TimeoutError):
+    """A bounded wait expired before the dispatched work became ready
+    (``Interruptible.synchronize(timeout_s=...)``,
+    ``resilience.dispatch_with_deadline``).
+
+    Deliberately NOT a :class:`ValueError`: a timeout is an operational
+    failure, not a bad argument, so existing ``except ValueError``
+    handlers never swallow it. Subclasses the builtin ``TimeoutError``
+    so generic deadline plumbing (``except TimeoutError``) also works."""
+
+
+class CorruptIndexError(RaftException):
+    """A serialized index failed integrity verification at load
+    (``spatial.ann.serialize.load_index``: per-array CRC32 manifest, the
+    format-v2 header contract). ``field`` names the damaged entry —
+    ``"__header__"`` when the archive/header itself is unreadable.
+
+    Deliberately NOT a :class:`ValueError` (see
+    :class:`RaftTimeoutError`): corruption must surface loudly rather
+    than be absorbed by a bad-argument handler."""
+
+    def __init__(self, msg: str, *, field: "str | None" = None,
+                 _stacklevel: int = 1):
+        super().__init__(msg, _stacklevel=_stacklevel + 1)
+        self.field = field
 
 
 def expects(cond: Any, msg: str, *args: Any) -> None:

@@ -1,0 +1,51 @@
+"""Resilience layer for the serving path — the explicit failure model.
+
+The reference builds robustness into its primitives
+(``raft::interruptible`` cancellable stream waits, NCCL async-error
+polling in ``sync_stream``, communicator round-trip self-tests); at the
+ROADMAP's serving scale (heavy traffic, millions of users) preemption,
+slow chips, dead shards, corrupt checkpoints, and poisoned inputs are
+ROUTINE, so every failure mode needs a bounded, classified, testable
+answer (docs/robustness.md):
+
+* deadlines + retries: :class:`Deadline`, :class:`RetryPolicy`,
+  :func:`dispatch_with_deadline` — bounded waits over
+  ``Interruptible.synchronize(timeout_s=)``; retries re-dispatch the
+  already-compiled program;
+* shard health: :class:`ShardHealth` (the per-rank validity mask the
+  degraded sharded searches consume), :func:`health_check` (the
+  communicator self-test sweep with per-collective timings);
+* degraded results: :class:`PartialSearchResult` — the
+  ``coverage``/``partial`` contract returned by the sharded searches
+  under ``shard_mask=``;
+* fault injection lives in :mod:`raft_tpu.testing.faults` so the chaos
+  suite (tests/test_resilience.py) proves each behavior on CPU in CI.
+"""
+
+from raft_tpu.resilience.deadline import (
+    Deadline,
+    RetryPolicy,
+    dispatch_with_deadline,
+)
+from raft_tpu.resilience.degraded import (
+    PartialSearchResult,
+    resolve_shard_mask,
+)
+from raft_tpu.resilience.health import (
+    HealthProbe,
+    HealthReport,
+    ShardHealth,
+    health_check,
+)
+
+__all__ = [
+    "Deadline",
+    "RetryPolicy",
+    "dispatch_with_deadline",
+    "PartialSearchResult",
+    "resolve_shard_mask",
+    "ShardHealth",
+    "HealthProbe",
+    "HealthReport",
+    "health_check",
+]

@@ -1,0 +1,195 @@
+"""Deadlines and retries for serving dispatches.
+
+The reference treats cancellable waits as a core primitive
+(``raft::interruptible`` polls a stream wait, interruptible.hpp:66-120)
+but leaves deadlines and retry policy to callers. At serving scale
+(ROADMAP north star) a slow chip, a preempted host, or a hung collective
+must turn into a bounded, classified error the caller can retry — not an
+indefinite block. This module provides that failure model:
+
+* :class:`Deadline` — a monotonic-clock budget shared across attempts;
+* :class:`RetryPolicy` — max attempts, exponential backoff with
+  DETERMINISTIC jitter (seeded; two replicas retrying the same failure
+  de-synchronize identically run-to-run, so chaos tests replay exactly),
+  and retryable-error classification;
+* :func:`dispatch_with_deadline` — dispatch + bounded wait + retry,
+  built on ``Interruptible.synchronize(timeout_s=...)``. Retries call
+  the SAME function object, so a jitted program is re-dispatched from
+  jax's compile cache: a retry costs dispatch, not compile
+  (tests/test_resilience.py audits trace and dispatch counts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import errors
+from raft_tpu.core.interruptible import Interruptible
+
+__all__ = ["Deadline", "RetryPolicy", "dispatch_with_deadline"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Deadline:
+    """A wall-clock budget on the monotonic clock.
+
+    ``Deadline.after(0.5)`` expires 500 ms from construction; every
+    attempt of a retried dispatch draws from the SAME budget, so retries
+    can never extend the caller's latency bound. ``Deadline.unbounded()``
+    never expires (remaining() is +inf).
+    """
+
+    expires_at: float  # time.monotonic() timestamp; +inf = unbounded
+
+    @classmethod
+    def after(cls, timeout_s: Optional[float]) -> "Deadline":
+        """A deadline ``timeout_s`` seconds from now (None = unbounded)."""
+        if timeout_s is None:
+            return cls.unbounded()
+        errors.expects(
+            timeout_s > 0, "Deadline.after: timeout_s=%s must be > 0",
+            timeout_s,
+        )
+        return cls(time.monotonic() + float(timeout_s))
+
+    @classmethod
+    def unbounded(cls) -> "Deadline":
+        return cls(math.inf)
+
+    @property
+    def bounded(self) -> bool:
+        return math.isfinite(self.expires_at)
+
+    def remaining(self) -> float:
+        """Seconds left (never negative; +inf when unbounded)."""
+        return max(0.0, self.expires_at - time.monotonic())
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Retry classification + exponential backoff with deterministic
+    jitter.
+
+    ``retryable_types`` classifies errors worth re-dispatching: by
+    default timeouts (:class:`raft_tpu.errors.RaftTimeoutError`) and
+    cancellations are retryable, while logic errors
+    (:class:`raft_tpu.errors.RaftLogicError` — a bad argument retried is
+    a bad argument again) and everything else are not. The backoff for
+    attempt ``a`` (1-based) is
+    ``min(max_delay_s, base_delay_s * multiplier**(a-1))`` scaled by a
+    jitter factor drawn from a counter-based PRNG seeded on
+    ``(seed, a)`` — deterministic across runs and replicas, so fault
+    injection replays exactly and two replicas with different seeds
+    de-synchronize their retry storms.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    jitter_frac: float = 0.25
+    seed: int = 0
+    retryable_types: Tuple[type, ...] = (
+        errors.RaftTimeoutError,
+        TimeoutError,
+    )
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        """Classification: may this failure be re-dispatched?"""
+        return isinstance(exc, self.retryable_types)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Delay before attempt ``attempt + 1`` (after failure number
+        ``attempt``, 1-based), with deterministic jitter in
+        ``[1 - jitter_frac, 1 + jitter_frac]``."""
+        errors.expects(attempt >= 1, "backoff_s: attempt=%d < 1", attempt)
+        base = min(
+            self.max_delay_s,
+            self.base_delay_s * self.multiplier ** (attempt - 1),
+        )
+        u = float(
+            np.random.default_rng((self.seed, attempt)).uniform(-1.0, 1.0)
+        )
+        return max(0.0, base * (1.0 + self.jitter_frac * u))
+
+
+def dispatch_with_deadline(
+    fn: Callable[..., Any], *args: Any,
+    timeout_s: Optional[float] = None,
+    deadline: Optional[Deadline] = None,
+    retry: Optional[RetryPolicy] = None,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Dispatch ``fn(*args, **kwargs)`` and wait for its outputs under a
+    deadline, retrying classified failures.
+
+    * ``timeout_s`` bounds EACH attempt's wait
+      (``Interruptible.synchronize(timeout_s=...)`` →
+      :class:`raft_tpu.errors.RaftTimeoutError` on expiry);
+    * ``deadline`` (optional) is an overall budget across all attempts —
+      each attempt's wait is clipped to the tighter of the two, and no
+      retry starts once it has expired;
+    * ``retry`` governs how many attempts and which errors qualify
+      (default: a single attempt, i.e. no retries);
+    * ``on_retry(attempt, exc, sleep_s)`` is called before each backoff
+      sleep — the observability hook (log/metric the failure).
+
+    ``fn`` is called again on retry, NOT re-traced: a jitted ``fn``
+    re-dispatches the already-compiled program (jax's jit cache keys on
+    the same shapes/statics), so a retry costs dispatch latency only.
+    The abandoned attempt's device work still completes in the
+    background (cooperative semantics, exactly like
+    ``Interruptible.cancel``) — on a mesh this means a retry may briefly
+    queue behind the straggler it is retrying past; the per-attempt
+    timeout covers that window.
+
+    Retries and BUFFER DONATION do not mix: a dispatch that donates its
+    inputs (``donate_queries=True`` on the sharded searches,
+    ``jax.jit(donate_argnums=...)``) consumes the argument buffers on
+    the FIRST attempt, so a retry would re-dispatch deleted arrays and
+    die on a non-retryable RuntimeError. Under a retry policy keep
+    donation off, or have ``fn`` materialize a fresh batch per call.
+
+    Cancellation composes: a ``cancel()`` aimed at this thread raises
+    ``InterruptedException`` out of the wait, which is NOT retryable
+    under the default policy and propagates immediately.
+    """
+    retry = RetryPolicy(max_attempts=1) if retry is None else retry
+    errors.expects(
+        retry.max_attempts >= 1,
+        "dispatch_with_deadline: max_attempts=%d < 1", retry.max_attempts,
+    )
+    overall = Deadline.unbounded() if deadline is None else deadline
+    attempt = 0
+    while True:
+        attempt += 1
+        wait_s: Optional[float] = timeout_s
+        if overall.bounded:
+            rem = overall.remaining()
+            wait_s = rem if wait_s is None else min(wait_s, rem)
+        try:
+            out = fn(*args, **kwargs)
+            Interruptible.synchronize(out, timeout_s=wait_s)
+            return out
+        except Exception as exc:
+            exhausted = (
+                attempt >= retry.max_attempts
+                or not retry.is_retryable(exc)
+                or overall.expired()
+            )
+            if exhausted:
+                raise
+            sleep_s = min(retry.backoff_s(attempt), overall.remaining())
+            if on_retry is not None:
+                on_retry(attempt, exc, sleep_s)
+            if sleep_s > 0:
+                time.sleep(sleep_s)

@@ -1,0 +1,131 @@
+"""Degraded-result contract for the sharded searches.
+
+When a shard is down, the sharded ANN searches
+(:func:`raft_tpu.comms.mnmg_ivf.mnmg_ivf_pq_search`,
+:func:`raft_tpu.comms.mnmg_ivf_flat.mnmg_ivf_flat_search` with
+``shard_mask=``) answer from the surviving shards instead of failing the
+whole query: a down shard contributes +inf distances to the merge, and
+the result reports HOW MUCH of the index was actually consulted —
+``coverage`` per query (fraction of probed lists owned by a live rank)
+and a ``partial`` flag. Non-finite query rows are neutralized in-graph
+at the serving entry (zeroed for compute, reported via ``row_valid``,
+outputs forced to +inf/-1) so one poisoned row cannot contaminate the
+merged top-k of its batchmates. docs/robustness.md states the full
+contract.
+
+This module carries the pieces shared by both engines: the result type,
+the mask resolution (accepts a :class:`~raft_tpu.resilience.health.ShardHealth`,
+an explicit array, or ``True`` for all-up), and the in-graph helpers the
+compiled shard_map bodies call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import compat, errors
+from raft_tpu.resilience.health import ShardHealth
+
+__all__ = ["PartialSearchResult", "resolve_shard_mask"]
+
+
+@compat.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PartialSearchResult:
+    """A sharded search answer that may cover only part of the index.
+
+    Attributes
+    ----------
+    distances : (nq, k) merged distances; +inf where no live candidate
+        filled the slot (and everywhere for an invalid query row).
+    ids : (nq, k) GLOBAL row ids; -1 wherever ``distances`` is +inf.
+    coverage : (nq,) float32 — fraction of this query's probed lists
+        owned by a LIVE rank (1.0 = fully served; 0.0 for an invalid
+        row). Lists owned by no rank (``expand_probe_set`` owner=-1
+        extras) count as not covered: they genuinely were not searched
+        here.
+    row_valid : (nq,) bool — False for query rows neutralized at entry
+        (non-finite input).
+
+    ``partial`` is the host-side verdict (syncs the small coverage /
+    validity arrays): True iff any row was invalid or any query's
+    coverage fell short of full.
+    """
+
+    distances: jax.Array
+    ids: jax.Array
+    coverage: jax.Array
+    row_valid: jax.Array
+
+    @property
+    def partial(self) -> bool:
+        cov = np.asarray(self.coverage)
+        valid = np.asarray(self.row_valid)
+        return bool((cov < 1.0).any() or (~valid).any())
+
+    @property
+    def min_coverage(self) -> float:
+        """The worst-served query's coverage (host sync)."""
+        return float(np.asarray(self.coverage).min())
+
+
+def resolve_shard_mask(shard_mask: Any, n_ranks: int) -> np.ndarray:
+    """Normalize a ``shard_mask=`` argument to an int32 ``(P,)`` validity
+    array (1 = up). Accepts ``True`` (all ranks up — the degraded result
+    type without any masking), a :class:`ShardHealth`, or any array-like
+    of per-rank truth. All-down is allowed: every slot merges to +inf
+    and coverage is 0 — the caller sees a fully partial result, not an
+    exception (degrade, don't fail)."""
+    if shard_mask is True:
+        return np.ones(n_ranks, np.int32)
+    if isinstance(shard_mask, ShardHealth):
+        arr = shard_mask.mask()
+    else:
+        arr = np.asarray(shard_mask)
+    errors.expects(
+        arr.shape == (n_ranks,),
+        "shard_mask: expected shape (%d,) to match the mesh, got %s",
+        n_ranks, tuple(arr.shape),
+    )
+    return (np.asarray(arr) != 0).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# In-graph helpers (traced inside the shard_map search bodies)
+# ---------------------------------------------------------------------------
+
+
+def sanitize_query_rows(qf: jax.Array):
+    """Neutralize non-finite query rows: returns ``(q_clean, row_valid)``
+    where poisoned rows are zeroed (they still flow through the compiled
+    program — static shapes — but a zero row cannot produce NaN/Inf
+    distances that would poison the shared merge) and ``row_valid`` marks
+    them for output masking."""
+    row_valid = jnp.all(jnp.isfinite(qf), axis=-1)
+    return jnp.where(row_valid[:, None], qf, 0.0), row_valid
+
+
+def probe_coverage(owner_of_probe: jax.Array, alive: jax.Array,
+                   row_valid: jax.Array) -> jax.Array:
+    """Per-query served fraction: of the probed lists (``owner_of_probe``
+    (nq, p) holding each probe's owning rank, -1 = unowned), the fraction
+    owned by a live rank per ``alive`` (P,). Invalid rows report 0."""
+    n_ranks = alive.shape[0]
+    live = (owner_of_probe >= 0) & (
+        alive[jnp.clip(owner_of_probe, 0, n_ranks - 1)] > 0
+    )
+    cov = jnp.mean(live.astype(jnp.float32), axis=-1)
+    return jnp.where(row_valid, cov, 0.0)
+
+
+def mask_invalid_rows(md: jax.Array, mi: jax.Array, row_valid: jax.Array):
+    """Force the outputs of neutralized rows to the empty answer
+    (+inf distances, -1 ids)."""
+    md = jnp.where(row_valid[:, None], md, jnp.inf)
+    mi = jnp.where(row_valid[:, None], mi, -1)
+    return md, mi

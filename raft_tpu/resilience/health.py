@@ -1,0 +1,172 @@
+"""Shard health tracking + communicator health checks.
+
+The reference polls NCCL's async error state inside ``sync_stream``
+(std_comms.hpp) and ships collective round-trip self-tests
+(``test_collective_*``); on TPU the XLA runtime surfaces fabric errors
+through the computation itself, so the serving layer tracks health
+HOST-SIDE: :class:`ShardHealth` is the per-rank validity mask the
+degraded sharded searches consume (``mnmg_ivf_pq_search`` /
+``mnmg_ivf_flat_search`` ``shard_mask=``), and :func:`health_check`
+wraps the communicator self-test suite
+(:func:`raft_tpu.comms.self_test.run_all_self_tests`) with
+per-collective timings — the liveness probe a serving loop runs between
+batches (docs/robustness.md).
+
+Rank-level downs come from EXTERNAL signals (a dead worker process, a
+missed heartbeat, an operator action) via ``mark_down``; the self-test
+probe validates the surviving fabric as a whole. The mask feeds the
+compiled search program as a RUNTIME argument, so flipping a rank's
+health never recompiles the serving program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from raft_tpu import errors
+
+__all__ = ["ShardHealth", "HealthProbe", "HealthReport", "health_check"]
+
+
+class ShardHealth:
+    """Host-side per-rank up/down tracker (thread-safe).
+
+    ``mask()`` snapshots the per-rank validity as an int32 ``(P,)``
+    array — 1 = up, 0 = down — in exactly the form the degraded sharded
+    searches take as their ``shard_mask`` runtime input.
+    """
+
+    def __init__(self, n_ranks: int):
+        errors.expects(n_ranks >= 1, "ShardHealth: n_ranks=%d < 1", n_ranks)
+        self._lock = threading.Lock()
+        self._up = np.ones(n_ranks, dtype=bool)
+
+    @property
+    def n_ranks(self) -> int:
+        return self._up.shape[0]
+
+    def _check_rank(self, rank: int) -> None:
+        errors.expects(
+            0 <= rank < self._up.shape[0],
+            "ShardHealth: rank %d out of range [0, %d)",
+            rank, self._up.shape[0],
+        )
+
+    def mark_down(self, rank: int) -> None:
+        """Record an external down signal for ``rank`` (idempotent)."""
+        self._check_rank(rank)
+        with self._lock:
+            self._up[rank] = False
+
+    def mark_up(self, rank: int) -> None:
+        """Record recovery of ``rank`` (idempotent)."""
+        self._check_rank(rank)
+        with self._lock:
+            self._up[rank] = True
+
+    def is_up(self, rank: int) -> bool:
+        self._check_rank(rank)
+        with self._lock:
+            return bool(self._up[rank])
+
+    @property
+    def n_up(self) -> int:
+        with self._lock:
+            return int(self._up.sum())
+
+    @property
+    def all_up(self) -> bool:
+        with self._lock:
+            return bool(self._up.all())
+
+    def mask(self) -> np.ndarray:
+        """Snapshot the validity mask as int32 ``(P,)`` (1 = up)."""
+        with self._lock:
+            return self._up.astype(np.int32)
+
+    def __repr__(self) -> str:  # compact operator-facing summary
+        with self._lock:
+            down = np.nonzero(~self._up)[0].tolist()
+        return (
+            f"ShardHealth(n_ranks={self.n_ranks}, "
+            f"down={down if down else 'none'})"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthProbe:
+    """One collective's round-trip result: pass/fail + wall time."""
+
+    ok: bool
+    seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthReport:
+    """The full self-test sweep with per-collective timings.
+
+    ``probes`` maps collective name → :class:`HealthProbe`; ``ok`` is
+    the conjunction. Timings include trace+compile on a cold program —
+    run one warm-up sweep at bring-up if you alert on latency.
+    """
+
+    probes: Dict[str, HealthProbe]
+
+    @property
+    def ok(self) -> bool:
+        return all(p.ok for p in self.probes.values())
+
+    @property
+    def failed(self) -> list:
+        return sorted(n for n, p in self.probes.items() if not p.ok)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(p.seconds for p in self.probes.values())
+
+
+def health_check(comms, *, health: Optional[ShardHealth] = None,
+                 raise_on_failure: bool = False) -> HealthReport:
+    """Run the communicator round-trip self-tests with per-collective
+    timings — the serving loop's fabric liveness probe.
+
+    Wraps :data:`raft_tpu.comms.self_test.SELF_TESTS` (the registry
+    behind ``run_all_self_tests``), timing each collective's round trip
+    individually. A probe that RAISES (an XLA runtime error from a torn
+    mesh) is recorded as failed, not propagated — the report is the
+    failure signal.
+
+    ``health``: when a sweep fails, every rank is marked down on the
+    tracker — a collective that cannot round-trip means the mesh program
+    cannot run at all, so no shard is servable until the mesh is rebuilt
+    (rank-granular downs come from external signals via ``mark_down``).
+    A PASSING sweep does NOT mark anything up: recovery of an
+    externally-downed rank is the external system's call.
+
+    ``raise_on_failure=True`` raises :class:`raft_tpu.errors.RaftException`
+    listing the failed collectives instead of returning the report.
+    """
+    from raft_tpu.comms.self_test import SELF_TESTS
+
+    probes: Dict[str, HealthProbe] = {}
+    for name, fn in SELF_TESTS.items():
+        t0 = time.perf_counter()
+        try:
+            ok = bool(fn(comms))
+        except Exception:  # torn mesh: the failure IS the signal
+            ok = False
+        probes[name] = HealthProbe(ok=ok, seconds=time.perf_counter() - t0)
+    report = HealthReport(probes=probes)
+    if not report.ok and health is not None:
+        for r in range(health.n_ranks):
+            health.mark_down(r)
+    if raise_on_failure and not report.ok:
+        raise errors.RaftException(
+            f"health_check: collectives failed round-trip: {report.failed}"
+        )
+    return report

@@ -7,16 +7,24 @@ makes persistence a practical necessity, so raft_tpu provides it
 natively: one ``.npz`` per index, arrays + a small JSON header carrying
 the static fields. Loading returns device-resident pytrees.
 
-Format: numpy ``.npz`` with keys ``__header__`` (JSON: index type,
-version, static fields) and one entry per array leaf. Portable across
-hosts; no pickle.
+Format (v2): numpy ``.npz`` with keys ``__header__`` (JSON: index type,
+version, static fields, integrity manifest) and one entry per array
+leaf. Portable across hosts; no pickle. The integrity manifest stamps
+each array's CRC32/shape/dtype at save time; ``load_index`` verifies
+every array against it and raises a structured
+:class:`raft_tpu.errors.CorruptIndexError` NAMING the damaged field
+instead of returning garbage — at serving scale a checkpoint that sat
+on disk through a torn write or bit-rot must fail loudly at load, not
+as silently wrong neighbors (docs/robustness.md "Checkpoint
+integrity"). v1 files (no manifest) still load.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Any
+import zlib
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +39,9 @@ from raft_tpu.sparse.distance import SparseColBlockIndex
 
 __all__ = ["save_index", "load_index"]
 
-_VERSION = 1
+_VERSION = 2
+# v1 = no integrity manifest (read-compat: loads without verification)
+_READABLE_VERSIONS = (1, 2)
 
 _TYPES = {
     "ivf_flat": IVFFlatIndex,
@@ -89,8 +99,17 @@ def _flatten(obj: Any, prefix: str, arrays: dict, static: dict) -> None:
             static[key] = v if not isinstance(v, tuple) else list(v)
 
 
+def _array_crc(arr: np.ndarray) -> int:
+    """CRC32 of the array's raw bytes (C order). tobytes() transiently
+    copies the largest slab; acceptable next to the archive write that
+    follows it."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
 def save_index(index, path) -> None:
-    """Serialize an ANN / sparse index to ``path`` (``.npz``)."""
+    """Serialize an ANN / sparse index to ``path`` (``.npz``, format v2:
+    the header carries a per-array CRC32/shape/dtype integrity manifest
+    that :func:`load_index` verifies)."""
     if type(index) not in _NAMES:
         _register_sharded()
     errors.expects(
@@ -101,10 +120,21 @@ def save_index(index, path) -> None:
     arrays: dict = {}
     static: dict = {}
     _flatten(index, "", arrays, static)
+    # manifest over the bytes actually archived (post bfloat16->uint16
+    # view), so verification needs no dtype knowledge to run
+    integrity = {
+        key: {
+            "crc32": _array_crc(arr),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+        for key, arr in arrays.items()
+    }
     header = {
         "type": _NAMES[type(index)],
         "version": _VERSION,
         "static": static,
+        "integrity": integrity,
     }
     # write straight to the file object: np.savez accepts one (and then
     # does not append ".npz" to the name), and the archive is not
@@ -121,6 +151,64 @@ def save_index(index, path) -> None:
 
 def _default_placer(name, arr):
     return jnp.asarray(arr)
+
+
+class _MeshMismatch(Exception):
+    """Internal: sharded archive's rank count != target mesh size —
+    load_index falls back to a host-side load + reshard."""
+
+
+class _VerifiedArchive:
+    """npz access with integrity verification per array read.
+
+    Every read is checked two ways: container-level damage (a zip member
+    that no longer decodes — zipfile CRC failures, torn npy headers)
+    converts to :class:`CorruptIndexError` naming the field, and for
+    format v2 the decoded bytes are verified against the header's
+    CRC32/shape/dtype manifest — which catches SILENT corruption the
+    container cannot (a rewritten archive whose zip CRCs match the
+    damaged payload; see raft_tpu.testing.faults.corrupt_bytes).
+    """
+
+    def __init__(self, npz, manifest: Optional[dict]):
+        self._npz = npz
+        self._manifest = manifest
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._npz
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        try:
+            arr = self._npz[key]
+        except Exception as e:  # zipfile.BadZipFile, ValueError, OSError
+            raise errors.CorruptIndexError(
+                f"load_index: array {key!r} unreadable ({e})", field=key
+            ) from e
+        if self._manifest is not None:
+            want = self._manifest.get(key)
+            if want is None:
+                raise errors.CorruptIndexError(
+                    f"load_index: array {key!r} missing from the "
+                    "integrity manifest (truncated or foreign header)",
+                    field=key,
+                )
+            if (
+                list(arr.shape) != want["shape"]
+                or str(arr.dtype) != want["dtype"]
+            ):
+                raise errors.CorruptIndexError(
+                    f"load_index: array {key!r} is {arr.dtype}{arr.shape}, "
+                    f"manifest says {want['dtype']}{tuple(want['shape'])}",
+                    field=key,
+                )
+            if _array_crc(arr) != want["crc32"]:
+                raise errors.CorruptIndexError(
+                    f"load_index: array {key!r} failed CRC32 verification "
+                    "— the checkpoint is corrupt; rebuild or restore from "
+                    "a replica",
+                    field=key,
+                )
+        return arr
 
 
 def _rebuild(cls, prefix: str, npz, static: dict, placer=_default_placer):
@@ -152,8 +240,12 @@ def _rebuild(cls, prefix: str, npz, static: dict, placer=_default_placer):
 
 
 def load_index(path, comms=None):
-    """Load an index saved by :func:`save_index`; arrays land on the
-    default device.
+    """Load an index saved by :func:`save_index`, verifying the format-v2
+    integrity manifest; arrays land on the default device. Damage — an
+    unreadable archive/header, a field that fails its CRC32, a
+    shape/dtype that disagrees with the manifest — raises
+    :class:`raft_tpu.errors.CorruptIndexError` naming the field (v1
+    files predate the manifest and load unverified).
 
     ``comms``: for a sharded ``mnmg_ivf_pq`` index, stream each slab
     DIRECTLY to its mesh placement as it is read — the 100M ``store_raw``
@@ -161,13 +253,40 @@ def load_index(path, comms=None):
     the default device first (then :func:`place_index`) would OOM exactly
     where the sharded index matters. With ``comms=None`` such an index
     loads onto the default device and needs
-    :func:`raft_tpu.comms.mnmg_ivf.place_index` before searching."""
-    with np.load(path) as npz:
-        header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
+    :func:`raft_tpu.comms.mnmg_ivf.place_index` before searching. A
+    sharded index built for a DIFFERENT rank count than ``comms`` loads
+    host-side and re-partitions via the ``place_index`` re-shard path —
+    the recovery story after losing a rank (docs/robustness.md); note
+    that path does materialize the slabs host-side first.
+    """
+    try:
+        return _load(path, comms)
+    except _MeshMismatch:
+        from raft_tpu.comms.mnmg_ivf import place_index
+
+        return place_index(comms, _load(path, None))
+
+
+def _load(path, comms):
+    try:
+        npz_file = np.load(path)
+    except Exception as e:  # not a zip / truncated central directory
+        raise errors.CorruptIndexError(
+            f"load_index: archive unreadable ({e})", field="__header__"
+        ) from e
+    with npz_file as npz:
+        try:
+            header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
+        except Exception as e:  # missing key, bad zip member, bad JSON
+            raise errors.CorruptIndexError(
+                f"load_index: header unreadable ({e}) — not a raft_tpu "
+                "index archive, or one damaged beyond recovery",
+                field="__header__",
+            ) from e
         errors.expects(
-            header.get("version") == _VERSION,
-            "load_index: version %s unsupported (expected %d)",
-            header.get("version"), _VERSION,
+            header.get("version") in _READABLE_VERSIONS,
+            "load_index: version %s unsupported (readable: %s)",
+            header.get("version"), list(_READABLE_VERSIONS),
         )
         if header.get("type") not in _TYPES:
             _register_sharded()
@@ -175,6 +294,7 @@ def load_index(path, comms=None):
             header.get("type") in _TYPES,
             "load_index: unknown index type %r", header.get("type"),
         )
+        archive = _VerifiedArchive(npz, header.get("integrity"))
         placer = _default_placer
         if comms is not None and header["type"] in (
             "mnmg_ivf_pq", "mnmg_ivf_flat",
@@ -185,19 +305,33 @@ def load_index(path, comms=None):
                 _SHARDED_FIELDS, field_sharding,
             )
 
-            def placer(name, arr):
-                # mirror place_index's rank-count guard: a mismatched
-                # mesh whose size divides the slab axis would otherwise
-                # place silently and drop shards inside the search
-                errors.expects(
-                    name not in _SHARDED_FIELDS
-                    or arr.shape[0] == comms.size,
-                    "load_index: sharded index built for %d ranks, "
-                    "mesh has %d", arr.shape[0], comms.size,
+            # rank-count check BEFORE any array is read: a mismatch
+            # must not first decompress + CRC-verify a multi-GB archive
+            # only to restart the whole load on the fallback path. v2
+            # headers carry every shape in the manifest; v1 pays one
+            # small sorted_ids read.
+            man = header.get("integrity") or {}
+            entry = man.get("sorted_ids")
+            n_ranks = (
+                int(entry["shape"][0]) if entry is not None
+                else int(archive["sorted_ids"].shape[0])
+            )
+            if n_ranks != comms.size:
+                raise _MeshMismatch(
+                    f"{n_ranks} ranks vs mesh {comms.size}"
                 )
+
+            def placer(name, arr):
+                # per-array guard kept as defense in depth: an archive
+                # whose slab fields disagree with the manifest would
+                # otherwise place silently and drop shards in the search
+                if name in _SHARDED_FIELDS and arr.shape[0] != comms.size:
+                    raise _MeshMismatch(
+                        f"{arr.shape[0]} ranks vs mesh {comms.size}"
+                    )
                 return jax.device_put(
                     arr, field_sharding(comms, name, arr.ndim)
                 )
         return _rebuild(
-            _TYPES[header["type"]], "", npz, header["static"], placer
+            _TYPES[header["type"]], "", archive, header["static"], placer
         )

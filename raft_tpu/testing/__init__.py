@@ -1,0 +1,10 @@
+"""Testing utilities shipped with the library — deterministic fault
+injection (:mod:`raft_tpu.testing.faults`) for exercising the resilience
+layer (``raft_tpu.resilience``) without hardware faults. The reference
+ships its comms self-tests as library code for the same reason: failure
+handling that is only testable in production is not testable.
+"""
+
+from raft_tpu.testing import faults
+
+__all__ = ["faults"]

@@ -1,0 +1,237 @@
+"""Deterministic fault injection for the resilience layer.
+
+Chaos engineering that replays: every injector here is seeded or
+fully specified, so a failing chaos run (tests/test_resilience.py)
+reproduces bit-for-bit. The injectors cover the serving failure model
+(docs/robustness.md):
+
+* :func:`inject_delay` — a straggler/slow-chip surrogate: a jitted
+  program whose completion is delayed host-side, with trace/dispatch
+  audit counters (proves a retry re-dispatches without recompiling);
+* :func:`inject_nonfinite` — poison query rows with NaN/Inf;
+* :func:`corrupt_bytes` — silent checkpoint corruption: flips payload
+  bytes inside a ``.npz`` and REWRITES the archive so the zip container
+  stays self-consistent — only the format-v2 CRC32 manifest can catch
+  it (``load_index`` → ``CorruptIndexError``);
+* :func:`cancel_after` — arm a delayed cross-thread cancel against an
+  in-flight ``Interruptible.synchronize``;
+* :func:`fail_rank` — mark shard(s) down on a
+  :class:`~raft_tpu.resilience.health.ShardHealth` (the degraded-search
+  mask).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import zipfile
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from raft_tpu import compat, errors
+from raft_tpu.core.interruptible import Interruptible
+from raft_tpu.resilience.health import ShardHealth
+
+__all__ = [
+    "FaultAudit",
+    "DelayedReady",
+    "inject_delay",
+    "inject_nonfinite",
+    "corrupt_bytes",
+    "cancel_after",
+    "fail_rank",
+]
+
+
+@dataclasses.dataclass
+class FaultAudit:
+    """Audit counters for an injected-fault program: ``traces`` counts
+    jit traces (== compiles per shape), ``dispatches`` counts actual
+    program EXECUTIONS (a host callback inside the program — proof each
+    attempt really re-ran rather than read a cached value), ``calls``
+    counts invocations of the wrapper. A deadline-retry that reuses the
+    compiled program shows ``traces == 1, dispatches == attempts``."""
+
+    traces: int = 0
+    dispatches: int = 0
+    calls: int = 0
+
+
+class DelayedReady:
+    """A straggler surrogate compatible with the readiness polling of
+    ``Interruptible.synchronize`` (which walks tree leaves and polls
+    ``is_ready()``): wraps a dispatched value and reports it not-ready
+    until a host-side deadline, even after the real dispatch finished.
+
+    Exists because CPU JAX runs jitted host callbacks synchronously at
+    dispatch — a callback SLEEP would block the caller, never producing
+    the dispatched-but-not-ready state a deadline must catch. Gating
+    ``is_ready()`` on the host clock instead models the slow chip
+    deterministically and load-independently (chaos runs replay).
+    """
+
+    def __init__(self, value, ready_at: float):
+        self.value = value
+        self._ready_at = ready_at
+
+    def is_ready(self) -> bool:
+        under = getattr(self.value, "is_ready", None)
+        return time.monotonic() >= self._ready_at and (
+            under is None or under()
+        )
+
+    def block_until_ready(self):
+        time.sleep(max(0.0, self._ready_at - time.monotonic()))
+        if hasattr(self.value, "block_until_ready"):
+            self.value.block_until_ready()
+        return self
+
+    def __array__(self, dtype=None):
+        import numpy as _np
+
+        return _np.asarray(self.value, dtype=dtype)
+
+
+def inject_delay(seconds: float, *, first_n: Optional[int] = None):
+    """A slow-kernel surrogate: returns ``(fn, audit)`` where ``fn(x)``
+    dispatches a jitted identity over ``x`` (audited via an in-program
+    host callback) and returns a :class:`DelayedReady` that polls
+    not-ready for ``seconds`` — exactly the shape
+    ``Interruptible.synchronize``/``dispatch_with_deadline`` wait on, so
+    a deadline expires against it like against a straggling chip.
+
+    ``first_n``: only the first N calls are slow (a transient straggler
+    — the retry-succeeds scenario); None = always slow. ``audit``
+    counts traces/dispatches for the retry-without-recompile proof.
+    """
+    errors.expects(seconds >= 0, "inject_delay: seconds=%s < 0", seconds)
+    audit = FaultAudit()
+
+    def _count(x):
+        audit.dispatches += 1
+        return x
+
+    @jax.jit
+    def ident(x):
+        audit.traces += 1
+        return compat.pure_callback(
+            _count, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+
+    def fn(x):
+        audit.calls += 1
+        slow = first_n is None or audit.calls <= first_n
+        return DelayedReady(
+            ident(x),
+            time.monotonic() + (seconds if slow else 0.0),
+        )
+
+    return fn, audit
+
+
+def inject_nonfinite(x, rows: Sequence[int], *,
+                     kind: str = "nan") -> np.ndarray:
+    """Return a float copy of ``x`` with the given rows poisoned
+    (``kind`` ∈ {"nan", "inf", "-inf"}) — the bad-input batch the
+    serving entry must neutralize (``shard_mask=`` searches report such
+    rows via ``row_valid``)."""
+    vals = {"nan": np.nan, "inf": np.inf, "-inf": -np.inf}
+    errors.expects(
+        kind in vals, "inject_nonfinite: kind=%r not in %s",
+        kind, sorted(vals),
+    )
+    arr = np.array(x, dtype=np.float32, copy=True)
+    idx = np.asarray(list(rows), dtype=np.int64)
+    errors.expects(
+        idx.size == 0 or (0 <= idx.min() and idx.max() < arr.shape[0]),
+        "inject_nonfinite: rows out of range [0, %d)", arr.shape[0],
+    )
+    arr[idx] = vals[kind]
+    return arr
+
+
+def corrupt_bytes(path, *, field: Optional[str] = None, n_bytes: int = 1,
+                  seed: int = 0, skip_header_bytes: int = 128) -> str:
+    """Silently corrupt a saved index checkpoint (``.npz``) in place.
+
+    Flips ``n_bytes`` bytes (XOR 0xFF) inside one array member's DATA
+    region — past the first ``skip_header_bytes`` so the ``.npy``
+    dtype/shape header still parses — then rewrites the archive, which
+    refreshes the zip container's own CRCs to match the damaged payload.
+    The result models bit-rot beneath the container's checksums (a torn
+    write, a bad DMA): only ``load_index``'s format-v2 per-array CRC32
+    manifest can detect it, raising
+    :class:`raft_tpu.errors.CorruptIndexError` naming the field.
+
+    ``field``: the header-relative array key to damage (e.g.
+    ``"sorted_ids"``); default picks one deterministically from
+    ``seed``. Byte positions are drawn from ``seed``. Returns the
+    damaged field name.
+    """
+    errors.expects(n_bytes >= 1, "corrupt_bytes: n_bytes=%d < 1", n_bytes)
+    rng = np.random.default_rng(seed)
+    with zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        payload = {n: z.read(n) for n in names}
+    candidates = sorted(n for n in names if n != "__header__.npy")
+    errors.expects(
+        bool(candidates), "corrupt_bytes: %s holds no array members", path
+    )
+    if field is None:
+        target = candidates[int(rng.integers(len(candidates)))]
+    else:
+        target = field if field.endswith(".npy") else field + ".npy"
+        errors.expects(
+            target in payload,
+            "corrupt_bytes: field %r not in archive (members: %s)",
+            field, candidates,
+        )
+    buf = bytearray(payload[target])
+    lo = min(skip_header_bytes, max(0, len(buf) - 1))
+    errors.expects(
+        len(buf) > lo,
+        "corrupt_bytes: member %r too small (%d bytes) to damage past "
+        "its header", target, len(buf),
+    )
+    positions = lo + rng.choice(
+        len(buf) - lo, size=min(n_bytes, len(buf) - lo), replace=False
+    )
+    for p in positions:
+        buf[int(p)] ^= 0xFF
+    payload[target] = bytes(buf)
+    # rewrite uncompressed, same member order: zipfile recomputes the
+    # container CRCs, leaving a self-consistent archive whose bytes
+    # disagree with the v2 integrity manifest
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
+        for n in names:
+            z.writestr(n, payload[n])
+    return target[:-len(".npy")]
+
+
+def cancel_after(seconds: float, *,
+                 thread_id: Optional[int] = None) -> threading.Timer:
+    """Arm a delayed cross-thread cancel: after ``seconds``, the target
+    thread's :class:`Interruptible` token is cancelled, breaking an
+    in-flight ``synchronize`` with ``InterruptedException`` (the
+    dispatched work still completes — cooperative semantics). Defaults
+    to the CALLING thread. Returns the started ``threading.Timer``
+    (``.cancel()`` it to disarm)."""
+    tid = threading.get_ident() if thread_id is None else thread_id
+    t = threading.Timer(seconds, Interruptible.cancel_thread, args=(tid,))
+    t.daemon = True
+    t.start()
+    return t
+
+
+def fail_rank(health: Union[ShardHealth, int], *ranks: int) -> ShardHealth:
+    """Mark shard(s) down. ``health`` is an existing
+    :class:`ShardHealth` (mutated in place) or a mesh size (a fresh
+    tracker is created). Returns the tracker — pass it (or its
+    ``mask()``) as the sharded searches' ``shard_mask=``."""
+    h = health if isinstance(health, ShardHealth) else ShardHealth(health)
+    for r in ranks:
+        h.mark_down(r)
+    return h

@@ -238,3 +238,76 @@ def test_hierarchical_axis_levels(hcomms):
     np.testing.assert_allclose(inner[4:], 26.0)
     # outer pairs (r, r+4): values (r+1) + (r+5)
     np.testing.assert_allclose(outer, [6, 8, 10, 12, 6, 8, 10, 12])
+
+
+# -- precondition contracts (ISSUE 3 satellites) ----------------------------
+
+
+def test_allgatherv_overflow_raises_clearly(comms):
+    """A contribution larger than max_count must raise a RaftLogicError
+    naming the contract — not jnp.pad's unrelated negative-pad error."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        c = comms.device_comms()
+        mine = x[0]                                  # (8, 1) per rank
+        slots, counts = c.allgatherv(mine, mine.shape[0], max_count=4)
+        return slots
+
+    x = jnp.ones((8, 8, 1), jnp.float32)  # 8 rows/rank > max_count=4
+    with pytest.raises(ValueError, match="max_count"):
+        comms.shard_map(
+            body, in_specs=P("ranks"), out_specs=P(None, "ranks"),
+        )(x)
+
+
+def test_reducescatter_indivisible_raises(comms):
+    """Both reducescatter paths check divisibility up front; the non-SUM
+    path would otherwise silently slice a truncated shard."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    for op in ("sum", "max"):
+        def body(x):
+            c = comms.device_comms()
+            return c.reducescatter(x[0], op=op)[None]
+
+        x = jnp.ones((8, 12), jnp.float32)  # 12 % 8 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            comms.shard_map(
+                body, in_specs=P("ranks"), out_specs=P("ranks"),
+            )(x)
+
+
+def test_p2p_batch_retry_after_validation_error(comms):
+    """Regression (ISSUE 3): a waitall rejected by validation must clear
+    the recorded sends/recvs, so a corrected retry on the SAME batch
+    succeeds instead of tripping over stale duplicate keys."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from raft_tpu import errors as err
+
+    def body(x):
+        c = comms.device_comms()
+        p2p = c.p2p_batch()
+        # attempt 1: unmatched (no irecv) -> validation error
+        p2p.isend(x * 10, src=0, dest=3, tag=0)
+        try:
+            p2p.waitall()
+        except err.RaftException:
+            pass  # expected; state must now be clear
+        # attempt 2 on the same batch: the corrected transfer set —
+        # before the fix, the stale (0, 3, 0) send collided here as a
+        # duplicate key
+        p2p.isend(x * 10, src=0, dest=3, tag=0)
+        p2p.irecv(src=0, dest=3, tag=0)
+        got = p2p.waitall()
+        return got[(0, 3, 0)]
+
+    x = jnp.arange(1, 9, dtype=jnp.float32).reshape(8, 1)
+    out = np.asarray(
+        comms.shard_map(body, in_specs=P("ranks"), out_specs=P("ranks"))(x)
+    )
+    assert out[3, 0] == 10.0  # rank 0's value*10 delivered at rank 3
+    assert out[0, 0] == 0.0

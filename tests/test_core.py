@@ -106,6 +106,87 @@ class TestInterruptible:
         x = jax.numpy.ones((8,))
         Interruptible.synchronize(x)
 
+    def test_registry_prunes_dead_threads(self):
+        """Dead threads' tokens are dropped at the next get_token, so the
+        registry stays bounded (the reference's weak-pointer registry
+        property, interruptible.hpp:140-168)."""
+        def hold_token():
+            Interruptible.get_token()
+
+        threads = [threading.Thread(target=hold_token) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        Interruptible.get_token()  # triggers the prune
+        live = {t.ident for t in threading.enumerate()}
+        with Interruptible._registry_lock:
+            stale = [k for k in Interruptible._registry if k not in live]
+        assert stale == []
+
+    def test_synchronize_timeout_raises(self):
+        """timeout_s bounds the wait on not-ready work with a
+        RaftTimeoutError (the deadline primitive under
+        resilience.dispatch_with_deadline)."""
+        from raft_tpu import errors
+        from raft_tpu.testing import faults
+
+        fn, _ = faults.inject_delay(10.0)
+        out = fn(jax.numpy.arange(4.0))
+        t0 = time.perf_counter()
+        with pytest.raises(errors.RaftTimeoutError):
+            Interruptible.synchronize(out, timeout_s=0.15)
+        assert time.perf_counter() - t0 < 5.0
+        # ready work never times out, even with a tiny budget
+        Interruptible.synchronize(jax.numpy.ones(3), timeout_s=1e-6)
+
+    def test_cancel_beats_timeout(self):
+        """Cancellation and deadline compose: whichever fires first wins.
+        A cancel arriving well before a generous deadline must surface as
+        InterruptedException, not be masked into a timeout."""
+        from raft_tpu.testing import faults
+
+        fn, _ = faults.inject_delay(10.0)
+        out = fn(jax.numpy.arange(4.0))
+        state = {}
+        started = threading.Event()
+        tid_holder = []
+
+        def waiter():
+            tid_holder.append(threading.get_ident())
+            started.set()
+            try:
+                Interruptible.synchronize(out, timeout_s=30.0)
+                state["result"] = "completed"
+            except InterruptedException:
+                state["result"] = "interrupted"
+            except Exception as e:  # pragma: no cover
+                state["result"] = type(e).__name__
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        started.wait()
+        faults.cancel_after(0.1, thread_id=tid_holder[0])
+        t.join(timeout=10)
+        assert state.get("result") == "interrupted", state
+
+    def test_timeout_beats_late_cancel(self):
+        """The converse ordering: a deadline expiring before any cancel
+        raises RaftTimeoutError — and the thread's token stays clean for
+        later waits."""
+        from raft_tpu import errors
+        from raft_tpu.testing import faults
+
+        fn, _ = faults.inject_delay(10.0)
+        out = fn(jax.numpy.arange(4.0))
+        timer = faults.cancel_after(30.0)  # armed far beyond the deadline
+        try:
+            with pytest.raises(errors.RaftTimeoutError):
+                Interruptible.synchronize(out, timeout_s=0.1)
+            Interruptible.yield_now()  # token untouched by the timeout
+        finally:
+            timer.cancel()
+
     def test_synchronize_interrupts_in_flight_wait(self):
         """cancel() from another thread must break a wait on still-running
         device work (the reference's polling-loop guarantee,

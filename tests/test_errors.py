@@ -182,3 +182,47 @@ class TestDecompParity:
     def test_svd_jacobi_bad_sweeps(self):
         with pytest.raises(ValueError, match="sweeps"):
             svd_jacobi(X, sweeps=0)
+
+
+# -- structured operational errors (ISSUE 3) --------------------------------
+
+
+class TestOperationalErrors:
+    """RaftTimeoutError / CorruptIndexError: same raise-site framing as
+    every RaftException, but deliberately NOT ValueErrors — existing
+    `except ValueError` handlers (the bad-argument contract above) must
+    be unaffected by operational failures."""
+
+    def test_exported(self):
+        assert "RaftTimeoutError" in errors.__all__
+        assert "CorruptIndexError" in errors.__all__
+
+    def test_timeout_hierarchy(self):
+        e = errors.RaftTimeoutError("deadline blown")
+        assert isinstance(e, errors.RaftException)
+        assert isinstance(e, TimeoutError)  # generic deadline plumbing
+        assert not isinstance(e, ValueError)
+        assert "RAFT failure at" in str(e) and "deadline blown" in str(e)
+
+    def test_corrupt_index_hierarchy_and_field(self):
+        e = errors.CorruptIndexError("bad crc", field="sorted_ids")
+        assert isinstance(e, errors.RaftException)
+        assert not isinstance(e, ValueError)
+        assert e.field == "sorted_ids"
+        assert "RAFT failure at" in str(e)
+        assert errors.CorruptIndexError("no field").field is None
+
+    def test_value_error_handlers_unaffected(self):
+        """A handler written for the validation contract must not absorb
+        operational errors — and must still catch RaftLogicError."""
+        def classify(exc):
+            try:
+                raise exc
+            except ValueError:
+                return "bad-argument"
+            except errors.RaftException:
+                return "operational"
+
+        assert classify(errors.RaftLogicError("k too big")) == "bad-argument"
+        assert classify(errors.RaftTimeoutError("slow")) == "operational"
+        assert classify(errors.CorruptIndexError("crc")) == "operational"

@@ -15,12 +15,15 @@ import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 
 import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "multiproc_worker.py")
 N_PROCS = 2
+TIMEOUT_S = 420
 
 
 def _free_port() -> int:
@@ -62,32 +65,95 @@ def _spawn_and_collect():
         )
         for r in range(N_PROCS)
     ]
-    # every bring-up failure mode must surface worker stderr in the
-    # assertion: a bare TimeoutExpired/IndexError here cost a triage
-    # round-trip when the shard_map AttributeError first broke the workers
-    outs = []
+    # Supervise ALL workers against ONE shared deadline (ISSUE 3): the
+    # old per-rank communicate(timeout=420) serialized the budgets — a
+    # worker hanging after its sibling finished late could strand the
+    # fixture for up to N x 420 s — and a fast nonzero exit left the
+    # survivor blocking inside a collective until ITS timeout. Now the
+    # first failure (nonzero exit or deadline) kills every survivor
+    # immediately. Every failure mode must still surface worker stderr
+    # in the assertion: a bare TimeoutExpired/IndexError here cost a
+    # triage round-trip when the shard_map AttributeError first broke
+    # the workers.
+    #
+    # Pipes are drained CONCURRENTLY by reader threads: a worker whose
+    # XLA/jax warnings exceed the OS pipe buffer would otherwise block
+    # in write() and be falsely reported as hung.
+    chunks = {(r, s): [] for r in range(N_PROCS) for s in ("out", "err")}
+
+    def _drain(rank, stream_name, stream):
+        chunks[(rank, stream_name)].append(stream.read())
+
+    readers = [
+        threading.Thread(
+            target=_drain, args=(r, name, stream), daemon=True
+        )
+        for r, p in enumerate(procs)
+        for name, stream in (("out", p.stdout), ("err", p.stderr))
+    ]
+    for t in readers:
+        t.start()
+    deadline = time.monotonic() + TIMEOUT_S
+    failed_rank = None
+    timed_out = []
     try:
-        for rank, p in enumerate(procs):
-            try:
-                out, err = p.communicate(timeout=420)
-            except subprocess.TimeoutExpired:
+        pending = set(range(N_PROCS))
+        while pending:
+            for rank in sorted(pending):
+                if procs[rank].poll() is not None:
+                    pending.discard(rank)
+                    if procs[rank].returncode != 0 and failed_rank is None:
+                        failed_rank = rank
+                        # a dead rank wedges its peers inside the next
+                        # collective — kill them NOW, not at the deadline
+                        for q in procs:
+                            if q.poll() is None:
+                                q.kill()
+            if pending and time.monotonic() > deadline:
+                timed_out = sorted(pending)
                 for q in procs:
                     if q.poll() is None:
                         q.kill()
-                out, err = p.communicate()
-                raise AssertionError(
-                    f"worker {rank} timed out after 420s; stderr:\n"
-                    f"{err[-4000:]}\nstdout tail:\n{out[-1000:]}"
-                )
-            assert p.returncode == 0, (
-                f"worker {rank} exited {p.returncode}; stderr:\n"
-                f"{err[-4000:]}\nstdout tail:\n{out[-1000:]}"
-            )
-            outs.append((out, err))
+                break
+            time.sleep(0.05)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    for t in readers:
+        t.join(timeout=30)  # EOF follows process death
+    for p in procs:
+        p.wait()
+    outs = [
+        (
+            "".join(chunks[(r, "out")]),
+            "".join(chunks[(r, "err")]),
+        )
+        for r in range(N_PROCS)
+    ]
+
+    def tails(rank):
+        out, err = outs[rank]
+        return (
+            f"stderr:\n{err[-4000:]}\nstdout tail:\n{out[-1000:]}"
+        )
+
+    if timed_out:
+        raise AssertionError(
+            f"workers {timed_out} timed out after {TIMEOUT_S}s "
+            f"(survivors killed);\n"
+            + "\n".join(f"-- worker {r} --\n{tails(r)}" for r in timed_out)
+        )
+    if failed_rank is not None:
+        raise AssertionError(
+            f"worker {failed_rank} exited "
+            f"{procs[failed_rank].returncode} (survivors killed);\n"
+            f"{tails(failed_rank)}"
+        )
+    for rank, p in enumerate(procs):
+        assert p.returncode == 0, (
+            f"worker {rank} exited {p.returncode}; {tails(rank)}"
+        )
     reports = []
     for rank, (out, err) in enumerate(outs):
         json_lines = [ln for ln in out.splitlines() if ln.startswith("{")]

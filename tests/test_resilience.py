@@ -1,0 +1,551 @@
+"""Chaos suite for the resilience layer (raft_tpu/resilience/ +
+raft_tpu/testing/faults.py) — every serving failure mode proven
+end-to-end on the 8-device virtual CPU mesh, in tier-1:
+
+* deadline-exceeded dispatch raises RaftTimeoutError; a retry succeeds
+  WITHOUT recompiling (trace/dispatch counts audited);
+* a fail_rank-masked shard yields a partial=True result whose valid
+  entries exactly match a healthy search restricted to the surviving
+  shards;
+* a corrupt_bytes-damaged checkpoint raises CorruptIndexError naming
+  the field, while an intact v1 (pre-manifest) file still loads;
+* a batch with injected NaN rows returns finite top-k for all valid
+  rows (and the empty answer, not garbage, for the poisoned ones);
+* an index checkpoint restores onto a DIFFERENT mesh size via the
+  place_index re-shard path with identical search results.
+
+The failure-model rationale lives in docs/robustness.md.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.comms import (
+    build_comms,
+    mnmg_ivf_flat_build,
+    mnmg_ivf_flat_search,
+    mnmg_ivf_pq_build,
+    mnmg_ivf_pq_search,
+    place_index,
+    reshard_index,
+)
+from raft_tpu.resilience import (
+    Deadline,
+    PartialSearchResult,
+    RetryPolicy,
+    ShardHealth,
+    dispatch_with_deadline,
+    health_check,
+)
+from raft_tpu.spatial.ann import (
+    IVFFlatParams,
+    IVFPQParams,
+    ivf_flat_build,
+    load_index,
+    save_index,
+)
+from raft_tpu.testing import faults
+from tests.oracles import np_knn_ids
+
+
+# ---------------------------------------------------------------------------
+# Deadline / RetryPolicy primitives (no mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_after_and_remaining(self):
+        d = Deadline.after(30.0)
+        assert d.bounded
+        assert 0.0 < d.remaining() <= 30.0
+        assert not d.expired()
+
+    def test_unbounded(self):
+        d = Deadline.unbounded()
+        assert not d.bounded
+        assert d.remaining() == float("inf")
+        assert not d.expired()
+        assert Deadline.after(None).remaining() == float("inf")
+
+    def test_expired(self):
+        d = Deadline.after(1e-6)
+        import time
+
+        time.sleep(0.01)
+        assert d.expired() and d.remaining() == 0.0
+
+    def test_nonpositive_rejected(self):
+        with pytest.raises(ValueError):
+            Deadline.after(0.0)
+
+
+class TestRetryPolicy:
+    def test_backoff_deterministic_and_bounded(self):
+        p = RetryPolicy(
+            base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5,
+            jitter_frac=0.25, seed=11,
+        )
+        q = RetryPolicy(
+            base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5,
+            jitter_frac=0.25, seed=11,
+        )
+        for a in range(1, 8):
+            assert p.backoff_s(a) == q.backoff_s(a)  # replayable
+            # exponential base, clipped, +-25% jitter
+            base = min(0.5, 0.1 * 2.0 ** (a - 1))
+            assert 0.75 * base <= p.backoff_s(a) <= 1.25 * base
+
+    def test_seed_decorrelates(self):
+        a = RetryPolicy(seed=1).backoff_s(1)
+        b = RetryPolicy(seed=2).backoff_s(1)
+        assert a != b  # two replicas de-synchronize their retries
+
+    def test_classification(self):
+        p = RetryPolicy()
+        assert p.is_retryable(errors.RaftTimeoutError("t"))
+        assert p.is_retryable(TimeoutError())
+        assert not p.is_retryable(errors.RaftLogicError("bad arg"))
+        assert not p.is_retryable(RuntimeError("boom"))
+
+
+# ---------------------------------------------------------------------------
+# dispatch_with_deadline + inject_delay (the straggler scenario)
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchWithDeadline:
+    def test_timeout_raises(self):
+        fn, audit = faults.inject_delay(5.0)
+        with pytest.raises(errors.RaftTimeoutError):
+            dispatch_with_deadline(fn, jnp.arange(4.0), timeout_s=0.1)
+        assert audit.calls == 1  # no retry without a policy
+
+    def test_timeout_not_a_valueerror(self):
+        """The serving loop's `except ValueError` (bad request) handler
+        must never swallow a deadline."""
+        fn, _ = faults.inject_delay(5.0)
+        with pytest.raises(errors.RaftTimeoutError):
+            try:
+                dispatch_with_deadline(fn, jnp.arange(4.0), timeout_s=0.1)
+            except ValueError:  # pragma: no cover - the bug being tested
+                pytest.fail("RaftTimeoutError was caught as ValueError")
+
+    def test_retry_succeeds_without_recompile(self):
+        """THE acceptance audit: attempt 1 times out, the retry
+        re-dispatches the already-compiled program (one trace, two
+        executions) and returns the right answer."""
+        fn, audit = faults.inject_delay(5.0, first_n=1)
+        x = jnp.arange(8.0)
+        seen = []
+        out = dispatch_with_deadline(
+            fn, x, timeout_s=0.25,
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.01),
+            on_retry=lambda a, e, s: seen.append((a, type(e).__name__)),
+        )
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+        assert audit.traces == 1, "retry must reuse the compiled program"
+        assert audit.dispatches == 2, "retry must actually re-execute"
+        assert audit.calls == 2
+        assert seen == [(1, "RaftTimeoutError")]
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad(_x):
+            calls.append(1)
+            raise errors.RaftLogicError("malformed batch")
+
+        with pytest.raises(ValueError, match="malformed batch"):
+            dispatch_with_deadline(
+                bad, jnp.arange(4.0), timeout_s=1.0,
+                retry=RetryPolicy(max_attempts=5, base_delay_s=0.01),
+            )
+        assert len(calls) == 1  # classification stopped the retries
+
+    def test_overall_deadline_caps_retries(self):
+        fn, audit = faults.inject_delay(5.0)
+        with pytest.raises(errors.RaftTimeoutError):
+            dispatch_with_deadline(
+                fn, jnp.arange(4.0), timeout_s=0.1,
+                deadline=Deadline.after(0.3),
+                retry=RetryPolicy(max_attempts=100, base_delay_s=0.01),
+            )
+        assert audit.calls < 100  # the budget, not max_attempts, stopped it
+
+
+# ---------------------------------------------------------------------------
+# ShardHealth + health_check
+# ---------------------------------------------------------------------------
+
+
+class TestShardHealth:
+    def test_mark_and_mask(self):
+        h = ShardHealth(4)
+        assert h.all_up and h.n_up == 4
+        h.mark_down(2)
+        h.mark_down(2)  # idempotent
+        assert not h.all_up and h.n_up == 3 and not h.is_up(2)
+        np.testing.assert_array_equal(h.mask(), [1, 1, 0, 1])
+        h.mark_up(2)
+        assert h.all_up
+        assert "down=none" in repr(h)
+
+    def test_bad_rank_rejected(self):
+        h = ShardHealth(2)
+        with pytest.raises(ValueError):
+            h.mark_down(2)
+        with pytest.raises(ValueError):
+            ShardHealth(0)
+
+    def test_fail_rank_helper(self):
+        h = faults.fail_rank(8, 1, 5)
+        np.testing.assert_array_equal(h.mask(), [1, 0, 1, 1, 1, 0, 1, 1])
+        h2 = faults.fail_rank(h, 0)
+        assert h2 is h and not h.is_up(0)
+
+
+def test_health_check_timed_sweep(comms8):
+    report = health_check(comms8)
+    assert report.ok and report.failed == []
+    assert len(report.probes) == 10  # the full self-test registry
+    assert all(p.seconds >= 0 for p in report.probes.values())
+    assert report.total_seconds > 0
+
+
+def test_health_check_failure_marks_all_down(comms8, monkeypatch):
+    from raft_tpu.comms import self_test as st
+
+    def torn_mesh(_comms):
+        raise RuntimeError("simulated torn mesh")
+
+    monkeypatch.setitem(st.SELF_TESTS, "allreduce", torn_mesh)
+    h = ShardHealth(8)
+    report = health_check(comms8, health=h)
+    assert not report.ok and report.failed == ["allreduce"]
+    assert h.n_up == 0  # a torn fabric serves no shard
+    with pytest.raises(errors.RaftException, match="allreduce"):
+        health_check(comms8, raise_on_failure=True)
+
+
+# ---------------------------------------------------------------------------
+# Degraded sharded search (both engines) on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def comms8():
+    return build_comms(jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((512, 16)).astype(np.float32)
+    q = x[::37][:12] + 0.05 * rng.standard_normal((12, 16)).astype(
+        np.float32
+    )
+    return x, q
+
+
+FLAT_PARAMS = IVFFlatParams(n_lists=8, kmeans_n_iters=4, seed=3)
+K = 5
+
+
+@pytest.fixture(scope="module")
+def flat_index(comms8, dataset):
+    x, _ = dataset
+    return mnmg_ivf_flat_build(comms8, x, FLAT_PARAMS)
+
+
+def _rank_row_ids(index, rank):
+    """GLOBAL row ids owned by ``rank`` (host-side, from the slab
+    layout: the valid region is [0, list_offsets[rank, -1]))."""
+    offs = np.asarray(index.list_offsets)
+    sids = np.asarray(index.sorted_ids)
+    return sids[rank, : offs[rank, -1]]
+
+
+def test_all_up_mask_matches_healthy_search(comms8, dataset, flat_index):
+    x, q = dataset
+    v0, i0 = mnmg_ivf_flat_search(
+        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    res = mnmg_ivf_flat_search(
+        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=True,
+    )
+    assert isinstance(res, PartialSearchResult)
+    assert res.partial is False
+    np.testing.assert_array_equal(np.asarray(res.coverage), 1.0)
+    assert np.asarray(res.row_valid).all()
+    np.testing.assert_allclose(
+        np.asarray(res.distances), np.asarray(v0), rtol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(i0))
+
+
+def test_fail_rank_matches_surviving_shard_search(
+    comms8, dataset, flat_index
+):
+    """THE degraded-search acceptance: with rank r down and every list
+    probed, the partial result's valid entries exactly equal the exact
+    top-k over the rows the SURVIVING shards own."""
+    x, q = dataset
+    # pick a rank that owns rows (they all do under LPT balance)
+    dead = 2
+    dead_ids = set(_rank_row_ids(flat_index, dead).tolist())
+    assert dead_ids, "test premise: the dead rank owns rows"
+    health = faults.fail_rank(ShardHealth(8), dead)
+    res = mnmg_ivf_flat_search(
+        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=health,
+    )
+    assert res.partial is True
+    cov = np.asarray(res.coverage)
+    assert (cov < 1.0).any() and (cov >= 0.0).all()
+    # oracle: exact search restricted to surviving rows (probe-everything
+    # IVF-Flat == brute force over the surviving shards' union)
+    alive_ids = np.array(
+        sorted(set(range(x.shape[0])) - dead_ids), np.int64
+    )
+    want = alive_ids[np_knn_ids(x[alive_ids], q, K)]
+    got_d = np.asarray(res.distances)
+    got_i = np.asarray(res.ids)
+    assert np.isfinite(got_d).all()  # >= K survivors everywhere
+    np.testing.assert_array_equal(got_i, want)
+    assert not (set(got_i.ravel().tolist()) & dead_ids)
+
+
+def test_all_ranks_down_degrades_not_raises(comms8, dataset, flat_index):
+    _, q = dataset
+    res = mnmg_ivf_flat_search(
+        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=np.zeros(8, np.int32),
+    )
+    assert res.partial is True and res.min_coverage == 0.0
+    assert np.isinf(np.asarray(res.distances)).all()
+    assert (np.asarray(res.ids) == -1).all()
+
+
+def test_nan_rows_neutralized(comms8, dataset, flat_index):
+    """THE bad-input acceptance: poisoned rows cannot contaminate their
+    batchmates — valid rows return the finite healthy answer, poisoned
+    rows return the empty answer."""
+    _, q = dataset
+    bad_rows = [1, 4]
+    qbad = faults.inject_nonfinite(q, bad_rows, kind="nan")
+    qbad = faults.inject_nonfinite(qbad, [7], kind="inf")
+    res = mnmg_ivf_flat_search(
+        comms8, flat_index, qbad, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=True,
+    )
+    rv = np.asarray(res.row_valid)
+    want_valid = np.ones(q.shape[0], bool)
+    want_valid[[1, 4, 7]] = False
+    np.testing.assert_array_equal(rv, want_valid)
+    assert res.partial is True
+    d, i = np.asarray(res.distances), np.asarray(res.ids)
+    assert np.isfinite(d[rv]).all()
+    assert np.isinf(d[~rv]).all() and (i[~rv] == -1).all()
+    np.testing.assert_array_equal(np.asarray(res.coverage)[~rv], 0.0)
+    # valid rows exactly match the healthy search of the same rows
+    v0, i0 = mnmg_ivf_flat_search(
+        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    np.testing.assert_array_equal(i[rv], np.asarray(i0)[rv])
+
+
+def test_degraded_pq_engine(comms8, dataset):
+    """The PQ engine shares the degraded contract (mask, +inf, coverage,
+    sanitize) — spot-check all-up parity and a down rank."""
+    x, q = dataset
+    idx = mnmg_ivf_pq_build(
+        comms8, x,
+        IVFPQParams(n_lists=8, pq_dim=4, kmeans_n_iters=3, seed=5),
+    )
+    v0, i0 = mnmg_ivf_pq_search(comms8, idx, q, K, n_probes=8,
+                                qcap=q.shape[0])
+    res = mnmg_ivf_pq_search(
+        comms8, idx, q, K, n_probes=8, qcap=q.shape[0], shard_mask=True,
+    )
+    assert res.partial is False
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(i0))
+    health = faults.fail_rank(ShardHealth(8), 0)
+    res2 = mnmg_ivf_pq_search(
+        comms8, idx, q, K, n_probes=8, qcap=q.shape[0], shard_mask=health,
+    )
+    assert res2.partial is True
+    dead_ids = set(_rank_row_ids(idx, 0).tolist())
+    live = np.asarray(res2.ids)[np.asarray(res2.ids) >= 0]
+    assert not (set(live.ravel().tolist()) & dead_ids)
+
+
+def test_warmup_resilient_variant(comms8, dataset, flat_index):
+    _, q = dataset
+    qc = flat_index.warmup(
+        comms8, q.shape[0], k=K, n_probes=8, shard_mask=True
+    )
+    assert isinstance(qc, int) and qc >= 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity (format v2) + mesh-size recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def small_index():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    return ivf_flat_build(
+        x, IVFFlatParams(n_lists=4, kmeans_n_iters=3, seed=1)
+    )
+
+
+def test_v2_roundtrip_carries_manifest(small_index, tmp_path):
+    p = tmp_path / "idx.npz"
+    save_index(small_index, p)
+    with np.load(p) as npz:
+        header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
+    assert header["version"] == 2
+    man = header["integrity"]
+    assert "data_sorted" in man and "centroids" in man
+    for entry in man.values():
+        assert set(entry) == {"crc32", "shape", "dtype"}
+    idx2 = load_index(p)
+    np.testing.assert_allclose(
+        np.asarray(idx2.centroids), np.asarray(small_index.centroids)
+    )
+
+
+def test_corrupt_bytes_names_the_field(small_index, tmp_path):
+    """THE integrity acceptance: silent payload damage (container CRCs
+    rewritten to match) is caught by the manifest and names the field."""
+    p = tmp_path / "idx.npz"
+    save_index(small_index, p)
+    damaged = faults.corrupt_bytes(p, field="data_sorted", seed=3)
+    assert damaged == "data_sorted"
+    with pytest.raises(errors.CorruptIndexError, match="data_sorted") as ei:
+        load_index(p)
+    assert ei.value.field == "data_sorted"
+    assert not isinstance(ei.value, ValueError)  # loud, not absorbable
+
+
+def test_corrupt_bytes_random_field_deterministic(small_index, tmp_path):
+    p = tmp_path / "idx.npz"
+    save_index(small_index, p)
+    damaged = faults.corrupt_bytes(p, seed=12)
+    with pytest.raises(errors.CorruptIndexError) as ei:
+        load_index(p)
+    assert ei.value.field == damaged
+
+
+def test_corrupt_header_caught(small_index, tmp_path):
+    p = tmp_path / "idx.npz"
+    save_index(small_index, p)
+    raw = bytearray(p.read_bytes())
+    raw[: len(raw) // 2] = os.urandom(len(raw) // 2)
+    p.write_bytes(bytes(raw))
+    with pytest.raises(errors.CorruptIndexError):
+        load_index(p)
+
+
+def test_v1_file_still_loads(small_index, tmp_path):
+    """Read-compat: a pre-manifest (v1) checkpoint loads unverified."""
+    from raft_tpu.spatial.ann import serialize
+
+    arrays, static = {}, {}
+    serialize._flatten(small_index, "", arrays, static)
+    header = {"type": "ivf_flat", "version": 1, "static": static}
+    p = tmp_path / "v1.npz"
+    with open(p, "wb") as f:
+        np.savez(
+            f,
+            __header__=np.frombuffer(
+                json.dumps(header).encode("utf-8"), dtype=np.uint8
+            ),
+            **arrays,
+        )
+    idx = load_index(p)
+    np.testing.assert_allclose(
+        np.asarray(idx.centroids), np.asarray(small_index.centroids)
+    )
+
+
+def test_future_version_rejected(small_index, tmp_path):
+    from raft_tpu.spatial.ann import serialize
+
+    arrays, static = {}, {}
+    serialize._flatten(small_index, "", arrays, static)
+    header = {"type": "ivf_flat", "version": 99, "static": static}
+    p = tmp_path / "v99.npz"
+    with open(p, "wb") as f:
+        np.savez(
+            f,
+            __header__=np.frombuffer(
+                json.dumps(header).encode("utf-8"), dtype=np.uint8
+            ),
+            **arrays,
+        )
+    with pytest.raises(ValueError, match="version"):
+        load_index(p)
+
+
+def test_restore_onto_smaller_mesh(comms8, dataset, flat_index, tmp_path):
+    """THE recovery acceptance: a sharded checkpoint built for 8 ranks
+    restores onto a 4-rank mesh (a lost rank pair) through the
+    place_index re-shard path with identical search results."""
+    x, q = dataset
+    v8, i8 = mnmg_ivf_flat_search(
+        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    p = tmp_path / "sharded.npz"
+    save_index(flat_index, p)
+    comms4 = build_comms(jax.devices()[:4])
+    idx4 = load_index(p, comms=comms4)  # mismatch -> host load + re-shard
+    assert idx4.sorted_ids.shape[0] == 4
+    v4, i4 = mnmg_ivf_flat_search(
+        comms4, idx4, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    np.testing.assert_allclose(np.asarray(v4), np.asarray(v8), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(i4), np.asarray(i8))
+    # reshard preserves the content inventory exactly
+    all8 = np.sort(
+        np.concatenate([_rank_row_ids(flat_index, r) for r in range(8)])
+    )
+    all4 = np.sort(
+        np.concatenate([_rank_row_ids(idx4, r) for r in range(4)])
+    )
+    np.testing.assert_array_equal(all8, all4)
+
+
+def test_place_index_reshards_directly(comms8, dataset, flat_index):
+    _, q = dataset
+    comms2 = build_comms(jax.devices()[:2])
+    idx2 = place_index(comms2, flat_index)
+    assert idx2.sorted_ids.shape[0] == 2
+    v8, i8 = mnmg_ivf_flat_search(
+        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    v2, i2 = mnmg_ivf_flat_search(
+        comms2, idx2, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i8))
+
+
+def test_reshard_rejects_ownerless_index(comms8, flat_index):
+    import dataclasses as dc
+
+    bad = dc.replace(
+        flat_index,
+        owner=jnp.full_like(jnp.asarray(flat_index.owner), -1),
+    )
+    comms2 = build_comms(jax.devices()[:2])
+    with pytest.raises(ValueError, match="owns no lists"):
+        reshard_index(comms2, bad)
