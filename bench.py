@@ -157,7 +157,7 @@ def extra_big_knn():
         lambda salt: jax.random.normal(
             jax.random.fold_in(key, salt), (nq, d), jnp.float32
         ),
-        search,
+        search, escalate=1,
     )
     if st is None:
         return {"metric": f"knn_fused_bf16_{n}x{d}_q{nq}_k{k}",
@@ -317,7 +317,7 @@ def extra_ivf_pq():
 
     float(jnp.sum(search(q)[0]))  # compile + warm
     st = chained_dispatch_stats(
-        lambda salt: q * (1.0 + 1e-6 * salt), search,
+        lambda salt: q * (1.0 + 1e-6 * salt), search, escalate=1,
     )
     if st is None:
         return {"metric": "ivf_pq", "error": "timing jitter-dominated"}
@@ -425,7 +425,7 @@ def extra_ivf_pq_10m():
     def chain_stats(f, qb):
         float(jnp.sum(f(qb)[0]))  # compile + warm
         return chained_dispatch_stats(
-            lambda salt: qb * (1.0 + 1e-6 * salt), f,
+            lambda salt: qb * (1.0 + 1e-6 * salt), f, escalate=1,
         )
 
     st = chain_stats(search, q)
@@ -518,7 +518,9 @@ def extra_mnmg_ivf_pq():
     from bench.common import chained_dispatch_stats
 
     float(jnp.sum(search(q)[0]))  # compile + warm
-    st = chained_dispatch_stats(lambda salt: q * (1.0 + 1e-6 * salt), search)
+    st = chained_dispatch_stats(
+        lambda salt: q * (1.0 + 1e-6 * salt), search, escalate=1,
+    )
     if st is None:
         return {"metric": "mnmg_ivf_pq", "error": "timing jitter-dominated"}
     return {
@@ -551,17 +553,22 @@ def extra_mnmg_shard_100m():
       chip (mean occupancy 16384*16/32768 = 8), i.e. the realistic
       per-chip search rate in the 100M deployment.
     * ``measured_chip_qps``: ONE measured jitted program — the
-      deployment-scale ~65k-centroid global coarse probe FUSED with the
-      qcap-8 shard-local search (``expand_probe_set`` attaches the
-      absent 7/8 of the centroid set with owner=-1; the query buffer is
-      donated, no host sync) — the per-chip serving cost as a single
-      dispatch instead of composed arithmetic.
-    * ``merge8_ms``: measured 8-way k-way merge (select_k over the
-      allgathered (8, nq, k) payloads — reference
-      knn_brute_force_faiss.cuh:289-368); the (nq, k) allgather itself
-      is ~2.6 MB over ICI — sub-ms, folded into the merge noise floor.
-    * ``projected_100m_qps`` = nq / (measured_chip + merge8) — only the
-      merge is still modeled.
+      deployment-scale ~65k-centroid global coarse probe (two-level:
+      ``attach_coarse_index`` makes it sub-linear in the centroid
+      count) FUSED with the qcap-8 shard-local search
+      (``expand_probe_set`` attaches the absent 7/8 of the centroid set
+      with owner=-1; the query buffer is donated, no host sync).
+    * ``sharded_e2e_qps``: the same fused program with
+      ``merge_ways=8`` — the in-program allgather + select_k
+      cross-shard merge runs at deployment width (reference
+      knn_merge_parts, knn_brute_force_faiss.cuh:289-368), so probe +
+      shard search + 8-way merge are ONE measured dispatch; nothing is
+      modeled anymore (the old ``projected_100m_qps`` arithmetic is
+      retired).
+    * ``probe_flop_ratio`` / ``probe_recall_vs_flat``: the two-level
+      probe's shape-accounted FLOP win over the flat centroid scan and
+      its probed-list recall against the flat scan on this workload
+      (the ``overprobe`` guardrail).
     """
     return _mnmg_shard_100m_impl("pq")
 
@@ -585,13 +592,14 @@ def extra_mnmg_shard_100m_flat():
 
     Fields mirror the PQ shard row so the two engines read side-by-side:
     ``value`` = full-load throughput-qcap QPS, ``qcap8_qps`` =
-    real-occupancy QPS, ``measured_chip_qps`` = the FUSED
+    real-occupancy QPS, ``measured_chip_qps`` = the FUSED two-level
     deployment-probe + shard-search program measured as one dispatch,
-    ``merge8_ms`` = measured 8-way merge, ``projected_100m_qps`` =
-    nq / (measured_chip + merge8) — only the merge still modeled. The PQ
-    index remains the engine when codes-only compression is required
-    (raw rows exceeding the mesh: higher d, fewer chips). Reference: the
-    Flat branch of the FAISS dispatch, ann_quantized_faiss.cuh:115-142."""
+    ``sharded_e2e_qps`` = the same program with the in-program 8-way
+    allgather+select_k merge (``merge_ways=8``) — the whole serving path
+    as one measured dispatch, nothing modeled. The PQ index remains the
+    engine when codes-only compression is required (raw rows exceeding
+    the mesh: higher d, fewer chips). Reference: the Flat branch of the
+    FAISS dispatch, ann_quantized_faiss.cuh:115-142."""
     return _mnmg_shard_100m_impl("flat")
 
 
@@ -602,7 +610,6 @@ def _mnmg_shard_100m_impl(engine: str):
     side-by-side and a timing fix can never apply to one row only."""
     from raft_tpu.comms import build_comms
     from raft_tpu.spatial.knn import brute_force_knn
-    from raft_tpu.spatial.selection import select_k
     from bench.common import chained_dispatch_stats, recall_at_k
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -660,11 +667,12 @@ def _mnmg_shard_100m_impl(engine: str):
         # recall 0.9575 for only ~5% QPS (6130 -> 5827; sweep readings
         # vs the then-bf16 oracle — the row's f32 oracle reads ~0.01
         # higher at the same config, docs/ivf_scale.md recall footnote)
-        def make_search(qcap, index=idx, donate=False):
+        def make_search(qcap, index=idx, donate=False, merge_ways=None):
             def search(qq):
                 return mnmg_ivf_pq_search(
                     comms, index, qq, k, n_probes=16, refine_ratio=8.0,
                     qcap=qcap, donate_queries=donate,
+                    merge_ways=merge_ways,
                 )
             return search
 
@@ -691,11 +699,11 @@ def _mnmg_shard_100m_impl(engine: str):
         ), metric="sqeuclidean")
         float(jnp.sum(idx.sorted_ids[:, -1].astype(jnp.float32)))
 
-        def make_search(qcap, index=idx, donate=False):
+        def make_search(qcap, index=idx, donate=False, merge_ways=None):
             def search(qq):
                 return mnmg_ivf_flat_search(
                     comms, index, qq, k, n_probes=16, qcap=qcap,
-                    donate_queries=donate,
+                    donate_queries=donate, merge_ways=merge_ways,
                 )
             return search
 
@@ -710,14 +718,19 @@ def _mnmg_shard_100m_impl(engine: str):
     # cap-2048 builds (8,224 local lists; it was 48 at the old auto-cap
     # 4,445 — an explicit qcap=48 rerun will NOT reproduce these rows)
     sim = make_search("throughput")
-    float(jnp.sum(sim(q)[0]))
-    st = chained_dispatch_stats(lambda s: q * (1.0 + 1e-6 * s), sim)
+    sim_out = sim(q)                  # warm + kept for the recall oracle
+    float(jnp.sum(sim_out[0]))
+    st = chained_dispatch_stats(
+        lambda s: q * (1.0 + 1e-6 * s), sim, escalate=1,
+    )
     if st is None:
         return {"metric": metric, "error": "jitter-dominated"}
 
     real = make_search(8)                          # true global occupancy
     float(jnp.sum(real(q)[0]))
-    st8 = chained_dispatch_stats(lambda s: q * (1.0 + 1e-6 * s), real)
+    st8 = chained_dispatch_stats(
+        lambda s: q * (1.0 + 1e-6 * s), real, escalate=1,
+    )
 
     # the fused one-dispatch serving program at DEPLOYMENT probe scale:
     # the deployment holds 8x this shard's rows, hence ~8x its split
@@ -725,10 +738,16 @@ def _mnmg_shard_100m_impl(engine: str):
     # from this shard's own centroids + jitter (same spatial
     # distribution, so the fused probe dilutes this shard's ownership
     # the way a real 8-chip probe map would) and attached with owner=-1
-    # (expand_probe_set) — one jitted program then runs the full global
-    # coarse probe AND the qcap-8 shard search, with the query buffer
-    # donated. Only the 8-way merge below remains modeled.
-    from raft_tpu.comms.mnmg_ivf import expand_probe_set
+    # (expand_probe_set); attach_coarse_index then builds the two-level
+    # coarse quantizer over the ~65k-centroid probe set, so the fused
+    # program's global probe is sub-linear in the centroid count (the
+    # r5 flat scan was ~50 ms of the 16k-query dispatch) — one jitted
+    # program runs the two-level global probe AND the qcap-8 shard
+    # search, with the query buffer donated.
+    from raft_tpu.comms.mnmg_ivf import attach_coarse_index, expand_probe_set
+    from raft_tpu.spatial.ann.common import (
+        coarse_probe_recall, probe_flop_accounting,
+    )
 
     # total split lists over ALL ranks (owner carries one entry per
     # global split list — correct for any mesh size, where the previous
@@ -745,29 +764,32 @@ def _mnmg_shard_100m_impl(engine: str):
         jax.random.fold_in(kc, 1), (n_gcents - n_shard_lists, d),
         jnp.float32,
     )
-    fused = make_search(8, index=expand_probe_set(idx, extra), donate=True)
+    eidx = attach_coarse_index(expand_probe_set(idx, extra))
+    flops = probe_flop_accounting(eidx.coarse, 16)
+    # the overprobe guardrail, measured on this workload: probed-list
+    # recall of the two-level probe vs the flat 65k-centroid scan
+    probe_rec = coarse_probe_recall(q[:1024], eidx.centroids, eidx.coarse, 16)
+    fused = make_search(8, index=eidx, donate=True)
     # warm on a FRESH buffer — the fused program donates its query input
     # and q is reused by the oracle below
     float(jnp.sum(fused(q + 0.0)[0]))
-    stf = chained_dispatch_stats(lambda s: q * (1.0 + 1e-6 * s), fused)
-
-    # measured 8-way merge on the actual (nq, k) payload shapes
-    dv, iv = sim(q)
-
-    @jax.jit
-    def merge8(d1):
-        pd = jnp.broadcast_to(d1[None], (8,) + d1.shape)
-        pi = jnp.broadcast_to(iv[None], (8,) + iv.shape)
-        fd = pd.transpose(1, 0, 2).reshape(nq, -1)
-        fi = pi.transpose(1, 0, 2).reshape(nq, -1)
-        return select_k(fd, k, indices=fi)
-    float(jnp.sum(merge8(dv)[0]))  # compile + warm before the chain
-    # millisecond-scale programs need long chains (+ the shared
-    # escalate-on-jitter retry) to clear host-timing noise on the
-    # 1-core driver box
-    stm = chained_dispatch_stats(
-        lambda s: dv * (1.0 + 1e-6 * s), merge8, n1=8, n2=64, escalate=1,
+    stf = chained_dispatch_stats(
+        lambda s: q * (1.0 + 1e-6 * s), fused, escalate=1,
     )
+
+    # the END-TO-END serving program: the same fused dispatch with the
+    # in-program cross-shard merge padded to deployment width
+    # (merge_ways=8 — allgather + select_k over the 8-way payload inside
+    # the ONE program; absent peers contribute +inf/-1, so results are
+    # identical and the select runs at deployment width). Replaces the
+    # retired projected_100m_qps arithmetic with a measured number.
+    e2e = make_search(8, index=eidx, donate=True, merge_ways=8)
+    float(jnp.sum(e2e(q + 0.0)[0]))
+    ste = chained_dispatch_stats(
+        lambda s: q * (1.0 + 1e-6 * s), e2e, escalate=1,
+    )
+
+    iv = sim_out[1]
 
     # recall vs exact oracle on a 1024-query subset, SLICED from the full
     # 16k-query run so it reflects the timed throughput-qcap config (a
@@ -796,19 +818,19 @@ def _mnmg_shard_100m_impl(engine: str):
         "index_gb": round(index_gb / 1e9, 2),
         **fields,
     }
-    if stm is not None:
-        out["merge8_ms"] = round(stm["ms"], 2)
+    out["n_probe_cents"] = n_gcents
+    out["probe_flop_ratio"] = round(flops["ratio"], 2)
+    out["probe_recall_vs_flat"] = round(probe_rec, 4)
     if st8 is not None:
         out["qcap8_qps"] = round(nq / (st8["ms"] / 1e3), 1)
     if stf is not None:
         out["measured_chip_qps"] = round(nq / (stf["ms"] / 1e3), 1)
         out["measured_chip_spread"] = stf["spread"]
-        out["n_probe_cents"] = n_gcents
-        if stm is not None:
-            # only the 8-way merge is modeled; probe + shard search are
-            # one measured dispatch
-            total_ms = stf["ms"] + stm["ms"]
-            out["projected_100m_qps"] = round(nq / (total_ms / 1e3), 1)
+    if ste is not None:
+        # probe + shard search + 8-way merge, ONE measured dispatch —
+        # nothing modeled (replaces the retired projected_100m_qps)
+        out["sharded_e2e_qps"] = round(nq / (ste["ms"] / 1e3), 1)
+        out["sharded_e2e_spread"] = ste["spread"]
     return out
 
 
@@ -992,7 +1014,7 @@ def _load_prev_bench():
 # because vs_prev covered only each row's primary value)
 _COMPANIONS = ("bf16_iters_per_s", "f32_highest_gflops",
                "brute_force_same_shape_qps", "build_warm_s",
-               "qcap8_qps", "measured_chip_qps", "projected_100m_qps")
+               "qcap8_qps", "measured_chip_qps", "sharded_e2e_qps")
 
 
 def _stamp_vs_prev(row, prev):
@@ -1026,10 +1048,69 @@ _PRINT_KEYS = {
     "recall_at_10", "recall_at_10_vs_shard", "build_s", "build_warm_s",
     "bf16_iters_per_s", "f32_highest_gflops", "vs_baseline",
     "brute_force_same_shape_qps", "measured_chip_qps", "qcap8_qps",
-    "merge8_ms", "projected_100m_qps", "vs_prev_significant", "extras",
+    "sharded_e2e_qps", "probe_recall_vs_flat", "probe_flop_ratio",
+    "vs_prev_significant", "extras",
     "rows", "engine", "nq", "p50_ms", "qcap",
-    "cold_cache_build_s", "cache_speedup", "within_2x_warm",
+    "within_2x_warm",
 }
+
+
+# secondary keys dropped (in order, recursively incl. their vs_prev_*
+# companions) when the printed line would exceed the driver's parse cap:
+# r5's artifact landed parsed=null because prose pushed the line over,
+# and a trimmed-but-parsing line beats a complete-but-unparsed one
+_TRIM_ORDER = (
+    "repeats", "within_2x_warm", "probe_flop_ratio", "build_warm_s",
+    "f32_highest_gflops", "bf16_iters_per_s", "measured_chip_qps",
+    "brute_force_same_shape_qps", "qcap8_qps", "build_s",
+)
+
+
+def _strip_key(row, key):
+    row.pop(key, None)
+    row.pop(f"vs_prev_{key}", None)
+    for v in row.values():
+        if isinstance(v, list):
+            for e in v:
+                if isinstance(e, dict):
+                    _strip_key(e, key)
+
+
+def _core_projection(row):
+    """Last-resort projection: primary value + unit + spread per row."""
+    keep = ("metric", "value", "unit", "spread", "error", "vs_prev")
+    out = {k: row[k] for k in keep if k in row}
+    if isinstance(row.get("extras"), list):
+        out["extras"] = [_core_projection(e) for e in row["extras"]]
+    return out
+
+
+def _fit_line(doc, cap: int = 1800) -> str:
+    """The printed driver line: the compact projection, trimmed key by
+    key (``_TRIM_ORDER``) until it fits the ~1,800-char parse cap, with
+    a json.loads round-trip self-check BEFORE printing — a line that
+    cannot round-trip or fit must never reach stdout as the artifact
+    (BENCH_r05 shipped parsed=null; full rows live in bench_full.json
+    either way)."""
+    c = _compact(doc)
+    line = json.dumps(c)
+    for key in _TRIM_ORDER:
+        if len(line) <= cap:
+            break
+        _strip_key(c, key)
+        line = json.dumps(c)
+    if len(line) > cap:
+        # per-(engine, nq) latency rows are the next-largest block
+        _strip_key(c, "rows")
+        line = json.dumps(c)
+    if len(line) > cap:
+        line = json.dumps(_core_projection(c))
+    # self-check: the emitted artifact must parse back and fit
+    parsed = json.loads(line)
+    if not isinstance(parsed, dict) or len(line) > cap:
+        print(f"bench: printed line is {len(line)} chars (> {cap} "
+              "driver parse cap) even after trimming", file=sys.stderr)
+    return line
 
 
 def _round_val(v):
@@ -1108,11 +1189,7 @@ def main():
                      "bench_full.json"), "w"
     ) as f:
         json.dump(doc, f, indent=1)
-    line = json.dumps(_compact(doc))
-    if len(line) > 1800:
-        print(f"bench: printed line is {len(line)} chars (> ~1800 "
-              "driver parse cap) — trim _PRINT_KEYS", file=sys.stderr)
-    print(line)
+    print(_fit_line(doc))
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ from raft_tpu.comms.self_test import run_all_self_tests
 from raft_tpu.comms.mnmg import mnmg_knn, mnmg_kmeans_fit
 from raft_tpu.comms.mnmg_ivf import (
     MnmgIVFPQIndex,
+    attach_coarse_index,
     expand_probe_set,
     mnmg_ivf_pq_build,
     mnmg_ivf_pq_build_distributed,
@@ -52,6 +53,7 @@ __all__ = [
     "mnmg_knn",
     "mnmg_kmeans_fit",
     "MnmgIVFPQIndex",
+    "attach_coarse_index",
     "expand_probe_set",
     "mnmg_ivf_pq_build",
     "mnmg_ivf_pq_build_distributed",

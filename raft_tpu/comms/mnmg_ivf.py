@@ -52,9 +52,13 @@ from raft_tpu.resilience.degraded import (
     sanitize_query_rows,
 )
 from raft_tpu.spatial.ann.common import (
+    CoarseIndex,
     ListStorage,
+    build_coarse_index,
     coarse_probe,
+    n_super_probes,
     resolve_qcap_arg,
+    two_level_probe,
 )
 from raft_tpu.spatial.ann.ivf_pq import (
     IVFPQIndex,
@@ -64,13 +68,17 @@ from raft_tpu.spatial.ann.ivf_pq import (
     _pq_grouped_impl,
     _train_pq_codebooks,
 )
-from raft_tpu.spatial.selection import select_k
+from raft_tpu.spatial.selection import merge_parts_select_k
 
 __all__ = [
-    "MnmgIVFPQIndex", "expand_probe_set", "mnmg_ivf_pq_build",
-    "mnmg_ivf_pq_build_distributed", "mnmg_ivf_pq_search", "place_index",
-    "reshard_index", "shard_rows",
+    "MnmgIVFPQIndex", "attach_coarse_index", "expand_probe_set",
+    "mnmg_ivf_pq_build", "mnmg_ivf_pq_build_distributed",
+    "mnmg_ivf_pq_search", "place_index", "reshard_index", "shard_rows",
 ]
+
+# query-block size of the in-program two-level probe's candidate rerank
+# (the (block, S*max_members, d) gather stays HBM-bounded at any nq)
+_PROBE_BLOCK_Q = 256
 
 
 @compat.register_dataclass
@@ -100,13 +108,18 @@ class MnmgIVFPQIndex:
     nl_pad: int = dataclasses.field(metadata=dict(static=True))
     max_list: int = dataclasses.field(metadata=dict(static=True))
     n_rows: int = dataclasses.field(metadata=dict(static=True))
+    # optional two-level coarse quantizer over the GLOBAL probe set
+    # (attach_coarse_index); the fused search probes through it when
+    # present instead of brute-scanning every centroid
+    coarse: typing.Optional[CoarseIndex] = None
 
     def warmup(self, comms: "Comms", nq: int, *, k: int = 10,
                n_probes: int = 8, qcap=None, list_block: int = 8,
                refine_ratio: float = 2.0, exact_selection: bool = True,
                approx_recall_target: float = 0.95,
                donate_queries: bool = False,
-               shard_mask=None) -> int:
+               shard_mask=None, overprobe: float = 2.0,
+               merge_ways: typing.Optional[int] = None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches: one all-zeros batch runs through
         :func:`mnmg_ivf_pq_search` and is blocked on, so the first real
@@ -131,6 +144,7 @@ class MnmgIVFPQIndex:
             exact_selection=exact_selection,
             approx_recall_target=approx_recall_target,
             donate_queries=donate_queries, shard_mask=shard_mask,
+            overprobe=overprobe, merge_ways=merge_ways,
         )
         jax.block_until_ready(out)
         return qc
@@ -825,9 +839,15 @@ def place_index(comms: Comms, index):
     for f in dataclasses.fields(type(index)):
         v = getattr(index, f.name)
         if v is not None and f.metadata.get("static") is None:
-            v = jax.device_put(
-                v, field_sharding(comms, f.name, np.ndim(v))
-            )
+            if dataclasses.is_dataclass(v):
+                # nested pytree (the two-level CoarseIndex): every array
+                # leaf replicates — it is probe metadata, never sharded
+                sh = NamedSharding(comms.mesh, P())
+                v = compat.tree_map(lambda a: jax.device_put(a, sh), v)
+            else:
+                v = jax.device_put(
+                    v, field_sharding(comms, f.name, np.ndim(v))
+                )
         kw[f.name] = v
     return type(index)(**kw)
 
@@ -853,19 +873,29 @@ def _cached_search(
     masks a down shard's contribution to +inf before the merge,
     non-finite query rows are neutralized in-graph, and the program
     returns ``(dists, ids, coverage, row_valid)``
-    (raft_tpu.resilience.degraded; docs/robustness.md)."""
+    (raft_tpu.resilience.degraded; docs/robustness.md).
+
+    The last three statics select the probe and merge widths:
+    ``use_coarse``/``overprobe`` engage the fused two-level coarse probe
+    (three extra replicated CoarseIndex array inputs), and ``merge_ways``
+    pads the allgathered per-shard payloads with +inf/-1 entries up to a
+    deployment's shard count so the in-program ``select_k`` merge runs at
+    deployment width on a smaller mesh (results are bit-identical to the
+    unpadded merge — emulated absent peers contribute nothing, exactly
+    like owner=-1 lists)."""
     (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
-     approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list) = statics
+     approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list,
+     use_coarse, overprobe, merge_ways) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
 
     def body(*opnds):
         if degraded:
             (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
-             loffs, lszs, q, alive) = opnds
+             loffs, lszs, q, sup_c, mem_i, cpad, alive) = opnds
         else:
             (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
-             loffs, lszs, q) = opnds
+             loffs, lszs, q, sup_c, mem_i, cpad) = opnds
             alive = None
         # sharded slabs arrive as (1, ...) blocks — drop the mesh axis
         lcents, codes_s, sids = lcents[0], codes_s[0], sids[0]
@@ -879,7 +909,14 @@ def _cached_search(
             qf, row_valid = sanitize_query_rows(qf)
         # replicated compute: identical global probes on every chip —
         # queries never move, only the (nq, k) results do
-        probes_g, _ = coarse_probe(qf, cents, n_probes)      # (nq, p)
+        if use_coarse:
+            probes_g, _ = two_level_probe(
+                qf, sup_c, mem_i, cpad, owner.shape[0], n_probes,
+                n_super_probes(n_probes, sup_c.shape[0], overprobe),
+                _PROBE_BLOCK_Q,
+            )
+        else:
+            probes_g, _ = coarse_probe(qf, cents, n_probes)  # (nq, p)
         probe_owner = owner[probes_g]                        # (nq, p)
         own = probe_owner == rank
         lp = jnp.where(
@@ -910,13 +947,13 @@ def _cached_search(
             # a down shard contributes +inf distances to the merge — its
             # candidates can never displace a live shard's
             vals = jnp.where(alive[rank] > 0, vals, jnp.inf)
-        # k-way merge: one small all_gather pair + select_k
+        # k-way merge: one small all_gather pair + select_k, executed
+        # IN-PROGRAM (the cross-shard merge is part of the one serving
+        # dispatch, not host composition); merge_ways pads to deployment
+        # width with +inf/-1 absent-peer payloads
         pd = ax.allgather(vals)                              # (P, nq, k)
         pi = ax.allgather(gids)
-        nq = q.shape[0]
-        flat_d = pd.transpose(1, 0, 2).reshape(nq, -1)
-        flat_i = pi.transpose(1, 0, 2).reshape(nq, -1)
-        md, mi = select_k(flat_d, k, indices=flat_i)
+        md, mi = merge_parts_select_k(pd, pi, k, ways=merge_ways)
         mi = jnp.where(jnp.isfinite(md), mi, -1)
         if degraded:
             cov = probe_coverage(probe_owner, alive, row_valid)
@@ -927,21 +964,60 @@ def _cached_search(
     sharded = P(comms.axis, None, None)
     sharded2 = P(comms.axis, None)
     rep2 = P(None, None)
+    rep3 = P(None, None, None)
     in_specs = (
-        rep2, P(None, None, None), P(None), P(None),
+        rep2, rep3, P(None), P(None),
         sharded, sharded,
-        sharded if store_raw else P(None, None, None),
+        sharded if store_raw else rep3,
         sharded2, sharded2, sharded2, rep2,
+        rep2, rep2, rep3,           # coarse: super_cents, member_ids, pad
     )
     out_specs = (rep2, rep2)
     if degraded:
         in_specs = in_specs + (P(None),)
         out_specs = (rep2, rep2, P(None), P(None))
     sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
-    # queries are positional argument 10 (the alive mask, when present,
-    # follows them); donation frees/aliases the batch buffer for the
-    # outputs (index slabs are never donated)
+    # queries are positional argument 10 (the coarse arrays and, when
+    # present, the alive mask follow them); donation frees/aliases the
+    # batch buffer for the outputs (index slabs are never donated)
     return jax.jit(sm, donate_argnums=(10,) if donate else ())
+
+
+def _coarse_probe_operands(index, d):
+    """The three replicated CoarseIndex operands of the fused search
+    program (shape-stable placeholders when the index carries no coarse
+    quantizer, so both variants present the same input pytree)."""
+    if index.coarse is not None:
+        c = index.coarse
+        return c.super_cents, c.member_ids, c.cents_padded
+    return (
+        jnp.zeros((1, d), jnp.float32),
+        jnp.zeros((1, 1), jnp.int32),
+        jnp.zeros((1, 1, d), jnp.float32),
+    )
+
+
+def _check_probe_args(index, nl_g, overprobe, merge_ways, n_ranks):
+    """Shared validation of the probe/merge knobs (both engines)."""
+    errors.expects(
+        index.coarse is None or index.coarse.n_cents == nl_g,
+        "coarse index covers %d centroids but the probe set has %d — "
+        "rebuild it (attach_coarse_index; expand_probe_set rebuilds "
+        "automatically)",
+        None if index.coarse is None else index.coarse.n_cents, nl_g,
+    )
+    errors.expects(
+        overprobe >= 1.0,
+        "overprobe=%s out of range [1, inf)", overprobe,
+    )
+    errors.expects(
+        merge_ways is None
+        or (isinstance(merge_ways, (int, np.integer))
+            and merge_ways >= n_ranks),
+        "merge_ways=%r must be an int >= the mesh size (%d) — it "
+        "emulates a WIDER deployment's merge, never a narrower one",
+        merge_ways, n_ranks,
+    )
 
 
 def expand_probe_set(index, extra_centroids):
@@ -955,10 +1031,14 @@ def expand_probe_set(index, extra_centroids):
     exactly like lists owned by an absent peer chip. Searching the
     returned index on a 1-device mesh therefore runs a chip's exact share
     of a larger deployment — deployment-scale coarse probe fused with the
-    shard-local search, one dispatch, no host composition — and only the
-    cross-chip merge remains to be modeled (bench.py's
-    ``measured_chip_qps`` rows). Works on both sharded engines (field
-    names are shared); slabs are aliased, not copied.
+    shard-local search, one dispatch, no host composition. Paired with
+    ``merge_ways=`` on the search, the in-program cross-shard merge also
+    runs at deployment width (bench.py's
+    ``measured_chip_qps``/``sharded_e2e_qps`` rows). Works on both
+    sharded engines (field names are shared); slabs are aliased, not
+    copied. An attached two-level coarse index
+    (:func:`attach_coarse_index`) is REBUILT over the expanded probe set
+    so the sub-linear probe covers the extras too.
     """
     extra = jnp.asarray(extra_centroids, jnp.float32)
     errors.expects(
@@ -967,7 +1047,7 @@ def expand_probe_set(index, extra_centroids):
         index.centroids.shape[1], tuple(extra.shape),
     )
     n_extra = extra.shape[0]
-    return dataclasses.replace(
+    out = dataclasses.replace(
         index,
         centroids=jnp.concatenate(
             [jnp.asarray(index.centroids, jnp.float32), extra]
@@ -980,7 +1060,41 @@ def expand_probe_set(index, extra_centroids):
             [jnp.asarray(index.local_id),
              jnp.zeros((n_extra,), jnp.int32)]
         ),
+        coarse=None,
     )
+    if index.coarse is not None:
+        # replay the user's coarse tuning (build_args records the
+        # ORIGINAL attach_coarse_index arguments, None where defaulted,
+        # so scale-dependent defaults re-derive for the wider set)
+        n_sup, cap, iters, seed = index.coarse.build_args
+        out = attach_coarse_index(
+            out, n_super=n_sup, member_cap=cap, kmeans_n_iters=iters,
+            seed=seed,
+        )
+    return out
+
+
+def attach_coarse_index(index, *, n_super=None, member_cap=None,
+                        kmeans_n_iters: int = 10, seed: int = 0):
+    """Attach (or rebuild) a two-level coarse quantizer
+    (:class:`raft_tpu.spatial.ann.common.CoarseIndex`) over a sharded
+    index's GLOBAL probe set — the sub-linear replacement for the fused
+    serving program's brute centroid scan, which at deployment scale
+    (~65k global centroids) dominates the per-chip serving cost
+    (BENCH_r05: probe ~50 ms of the 16k-query dispatch).
+
+    Works on both sharded engines (field names are shared). The searches
+    engage the two-level probe automatically when the index carries it;
+    ``overprobe=`` on the search trades probe FLOPs for probe recall
+    (audit with :func:`raft_tpu.spatial.ann.common.coarse_probe_recall`).
+    Serialization carries it (format v3, older formats load with
+    ``coarse=None``); :func:`expand_probe_set` rebuilds it over the
+    expanded set."""
+    coarse = build_coarse_index(
+        index.centroids, n_super=n_super, member_cap=member_cap,
+        kmeans_n_iters=kmeans_n_iters, seed=seed,
+    )
+    return dataclasses.replace(index, coarse=coarse)
 
 
 def mnmg_ivf_pq_search(
@@ -992,6 +1106,8 @@ def mnmg_ivf_pq_search(
     qcap_max_drop_frac: typing.Optional[float] = None,
     donate_queries: bool = False,
     shard_mask=None,
+    overprobe: float = 2.0,
+    merge_ways: typing.Optional[int] = None,
 ):
     """Distributed grouped ADC search over a list-sharded index.
 
@@ -1033,6 +1149,13 @@ def mnmg_ivf_pq_search(
     becomes :class:`raft_tpu.resilience.PartialSearchResult` carrying
     per-query ``coverage`` and the ``partial`` flag. The mask is a
     runtime input: flipping a rank's health never recompiles.
+
+    ``overprobe`` (static) widens the two-level coarse probe's super
+    scan when the index carries a coarse quantizer
+    (:func:`attach_coarse_index`; ignored otherwise). ``merge_ways``
+    (static) pads the in-program cross-shard merge to a deployment's
+    shard count — results are identical (absent peers contribute
+    +inf/-1), the ``select_k`` runs at deployment width.
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -1047,9 +1170,11 @@ def mnmg_ivf_pq_search(
         "approx_recall_target=%s out of range (0, 1]", approx_recall_target,
     )
     nl_g = index.centroids.shape[0]
+    _check_probe_args(index, nl_g, overprobe, merge_ways, comms.size)
     qcap, _ = resolve_qcap_arg(
         qcap, q, index.centroids, nl_g, n_probes,
-        max_drop_frac=qcap_max_drop_frac,
+        max_drop_frac=qcap_max_drop_frac, coarse=index.coarse,
+        overprobe=overprobe,
     )
     list_block = max(1, min(list_block, index.nl_pad))
     store_raw = index.vectors_sorted is not None
@@ -1057,6 +1182,8 @@ def mnmg_ivf_pq_search(
         k, n_probes, qcap, list_block, refine_ratio, exact_selection,
         approx_recall_target, index.pq_dim, index.pq_bits, index.n_pad,
         index.nl_pad, index.max_list,
+        index.coarse is not None, float(overprobe),
+        None if merge_ways is None else int(merge_ways),
     )
     degraded = shard_mask is not None
     fn = _cached_search(
@@ -1067,10 +1194,13 @@ def mnmg_ivf_pq_search(
         index.vectors_sorted if store_raw
         else jnp.zeros((comms.size, 1, 1), jnp.float32)
     )
+    sup_c, mem_i, cpad = _coarse_probe_operands(
+        index, index.centroids.shape[1]
+    )
     args = (
         index.centroids, index.codebooks, index.owner, index.local_id,
         index.local_cents, index.codes_sorted, vecs, index.sorted_ids,
-        index.list_offsets, index.list_sizes, q,
+        index.list_offsets, index.list_sizes, q, sup_c, mem_i, cpad,
     )
     if not degraded:
         return fn(*args)

@@ -53,23 +53,29 @@ from raft_tpu.resilience.degraded import (
 from raft_tpu.comms.mnmg_ivf import (
     _cached_program,
     _cdiv_host,
+    _check_probe_args,
+    _coarse_probe_operands,
     _exchange_and_assemble,
     _P3,
+    _PROBE_BLOCK_Q,
     _train_coarse_distributed,
     place_index,
     shard_rows,
 )
 from raft_tpu.spatial.ann.common import (
+    CoarseIndex,
     ListStorage,
     coarse_probe,
+    n_super_probes,
     resolve_qcap_arg,
+    two_level_probe,
 )
 from raft_tpu.spatial.ann.ivf_flat import (
     IVFFlatIndex,
     IVFFlatParams,
     _grouped_impl,
 )
-from raft_tpu.spatial.selection import select_k
+from raft_tpu.spatial.selection import merge_parts_select_k
 
 __all__ = [
     "MnmgIVFFlatIndex", "mnmg_ivf_flat_build",
@@ -98,10 +104,15 @@ class MnmgIVFFlatIndex:
     max_list: int = dataclasses.field(metadata=dict(static=True))
     n_rows: int = dataclasses.field(metadata=dict(static=True))
     metric: str = dataclasses.field(metadata=dict(static=True))
+    # optional two-level coarse quantizer over the GLOBAL probe set
+    # (raft_tpu.comms.mnmg_ivf.attach_coarse_index)
+    coarse: typing.Optional[CoarseIndex] = None
 
     def warmup(self, comms: "Comms", nq: int, *, k: int = 10,
                n_probes: int = 8, qcap=None, list_block: int = 32,
-               donate_queries: bool = False, shard_mask=None) -> int:
+               donate_queries: bool = False, shard_mask=None,
+               overprobe: float = 2.0,
+               merge_ways: typing.Optional[int] = None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through
         :func:`mnmg_ivf_flat_search` — the Flat sibling of
@@ -119,7 +130,8 @@ class MnmgIVFFlatIndex:
         out = mnmg_ivf_flat_search(
             comms, self, q0, k, n_probes=n_probes, qcap=qc,
             list_block=list_block, donate_queries=donate_queries,
-            shard_mask=shard_mask,
+            shard_mask=shard_mask, overprobe=overprobe,
+            merge_ways=merge_ways,
         )
         jax.block_until_ready(out)
         return qc
@@ -263,18 +275,22 @@ def _cached_search(
     caller must not reuse the array after the call). ``degraded=True``
     compiles the resilient variant — an ``alive`` (P,) runtime mask,
     +inf contributions from down shards, in-graph query sanitization,
-    and (dists, ids, coverage, row_valid) outputs (docs/robustness.md)."""
-    (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list) = statics
+    and (dists, ids, coverage, row_valid) outputs (docs/robustness.md).
+    The last three statics select the probe/merge widths exactly as in
+    the PQ engine's ``_cached_search`` (two-level coarse probe +
+    deployment-width in-program merge)."""
+    (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list,
+     use_coarse, overprobe, merge_ways) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
 
     def body(*opnds):
         if degraded:
             (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
-             q, alive) = opnds
+             q, sup_c, mem_i, cpad, alive) = opnds
         else:
             (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
-             q) = opnds
+             q, sup_c, mem_i, cpad) = opnds
             alive = None
         lcents, vecs, sids = lcents[0], vecs_s[0], sids[0]
         loffs, lszs = loffs[0], lszs[0]
@@ -285,7 +301,14 @@ def _cached_search(
         if degraded:
             qf, row_valid = sanitize_query_rows(qf)
         # replicated compute: identical global probes on every chip
-        probes_g, _ = coarse_probe(qf, cents, n_probes)      # (nq, p)
+        if use_coarse:
+            probes_g, _ = two_level_probe(
+                qf, sup_c, mem_i, cpad, owner.shape[0], n_probes,
+                n_super_probes(n_probes, sup_c.shape[0], overprobe),
+                _PROBE_BLOCK_Q,
+            )
+        else:
+            probes_g, _ = coarse_probe(qf, cents, n_probes)  # (nq, p)
         probe_owner = owner[probes_g]                        # (nq, p)
         own = probe_owner == rank
         lp = jnp.where(
@@ -312,12 +335,11 @@ def _cached_search(
         if degraded:
             # a down shard contributes +inf distances to the merge
             vals = jnp.where(alive[rank] > 0, vals, jnp.inf)
+        # in-program cross-shard merge (merge_ways pads to deployment
+        # width with +inf/-1 absent-peer payloads — identical results)
         pd = ax.allgather(vals)                              # (P, nq, k)
         pi = ax.allgather(gids)
-        nq = q.shape[0]
-        flat_d = pd.transpose(1, 0, 2).reshape(nq, -1)
-        flat_i = pi.transpose(1, 0, 2).reshape(nq, -1)
-        md, mi = select_k(flat_d, k, indices=flat_i)
+        md, mi = merge_parts_select_k(pd, pi, k, ways=merge_ways)
         mi = jnp.where(jnp.isfinite(md), mi, -1)
         if degraded:
             cov = probe_coverage(probe_owner, alive, row_valid)
@@ -328,17 +350,19 @@ def _cached_search(
     sharded3 = P(comms.axis, None, None)
     sharded2 = P(comms.axis, None)
     rep2 = P(None, None)
+    rep3 = P(None, None, None)
     in_specs = (
         rep2, P(None), P(None),
         sharded3, sharded3, sharded2, sharded2, sharded2, rep2,
+        rep2, rep2, rep3,           # coarse: super_cents, member_ids, pad
     )
     out_specs = (rep2, rep2)
     if degraded:
         in_specs = in_specs + (P(None),)
         out_specs = (rep2, rep2, P(None), P(None))
     sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
-    # queries are positional argument 8; the alive mask, when present,
-    # follows them (donation: serving mode)
+    # queries are positional argument 8; the coarse arrays and, when
+    # present, the alive mask follow them (donation: serving mode)
     return jax.jit(sm, donate_argnums=(8,) if donate else ())
 
 
@@ -349,6 +373,8 @@ def mnmg_ivf_flat_search(
     qcap_max_drop_frac: typing.Optional[float] = None,
     donate_queries: bool = False,
     shard_mask=None,
+    overprobe: float = 2.0,
+    merge_ways: typing.Optional[int] = None,
 ):
     """Distributed grouped EXACT search over a list-sharded IVF-Flat
     index. Returns (distances, GLOBAL row ids), both (nq, k) replicated
@@ -376,6 +402,12 @@ def mnmg_ivf_flat_search(
     the return type becomes
     :class:`raft_tpu.resilience.PartialSearchResult` with per-query
     ``coverage`` and the ``partial`` flag (docs/robustness.md).
+
+    ``overprobe``/``merge_ways`` (both static) as in the PQ engine: the
+    two-level coarse probe's super-scan width when the index carries a
+    coarse quantizer, and deployment-width padding of the in-program
+    cross-shard merge (identical results; absent peers contribute
+    +inf/-1).
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -392,23 +424,30 @@ def mnmg_ivf_flat_search(
         k, index.max_list,
     )
     nl_g = index.centroids.shape[0]
+    _check_probe_args(index, nl_g, overprobe, merge_ways, comms.size)
     qcap, _ = resolve_qcap_arg(
         qcap, q, index.centroids, nl_g, n_probes,
-        max_drop_frac=qcap_max_drop_frac,
+        max_drop_frac=qcap_max_drop_frac, coarse=index.coarse,
+        overprobe=overprobe,
     )
     list_block = max(1, min(list_block, index.nl_pad))
     statics = (
         k, n_probes, qcap, list_block, index.n_pad, index.nl_pad,
         index.max_list,
+        index.coarse is not None, float(overprobe),
+        None if merge_ways is None else int(merge_ways),
     )
     degraded = shard_mask is not None
     fn = _cached_search(
         comms.mesh, comms.axis, statics, donate_queries, degraded
     )
+    sup_c, mem_i, cpad = _coarse_probe_operands(
+        index, index.centroids.shape[1]
+    )
     args = (
         index.centroids, index.owner, index.local_id, index.local_cents,
         index.vectors_sorted, index.sorted_ids, index.list_offsets,
-        index.list_sizes, q,
+        index.list_sizes, q, sup_c, mem_i, cpad,
     )
     if not degraded:
         vals, ids = fn(*args)

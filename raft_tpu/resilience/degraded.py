@@ -48,7 +48,9 @@ class PartialSearchResult:
         owned by a LIVE rank (1.0 = fully served; 0.0 for an invalid
         row). Lists owned by no rank (``expand_probe_set`` owner=-1
         extras) count as not covered: they genuinely were not searched
-        here.
+        here. The contract is probe-agnostic — identical under the flat
+        centroid scan and the two-level ``CoarseIndex`` probe
+        (tests/test_resilience.py parametrizes the suite over both).
     row_valid : (nq,) bool — False for query rows neutralized at entry
         (non-finite input).
 

@@ -22,8 +22,10 @@ import numpy as np
 from raft_tpu import compat
 
 __all__ = [
-    "ListStorage", "build_list_storage", "split_oversized_lists",
-    "static_qcap",
+    "CoarseIndex", "ListStorage", "build_coarse_index",
+    "build_list_storage", "coarse_probe_recall", "default_coarse_geometry",
+    "n_super_probes", "probe_flop_accounting", "split_oversized_lists",
+    "static_qcap", "two_level_probe",
 ]
 
 
@@ -43,6 +45,225 @@ class ListStorage:
     list_sizes: jax.Array     # (n_lists,) int32
     n: int = dataclasses.field(metadata=dict(static=True))
     max_list: int = dataclasses.field(metadata=dict(static=True))
+
+
+@compat.register_dataclass
+@dataclasses.dataclass
+class CoarseIndex:
+    """Two-level coarse quantizer over a centroid set — the sub-linear
+    replacement for the flat query x all-centroids probe scan at
+    deployment scale (~65k global centroids), after RAFT's own
+    balanced-hierarchical coarse quantizer in ``ivf_pq``/
+    ``kmeans_balanced``.
+
+    The n_cents centroids are clustered into ~sqrt(n_cents)
+    super-centroids; each super cluster's member centroids are stored as
+    one padded rectangular block (the same sorted-by-list layout decision
+    as :class:`ListStorage` — rectangular block gathers, MXU-friendly,
+    sentinel-masked). Probing scores queries against the small super set,
+    gathers the top super clusters' member blocks, and exactly reranks
+    only those candidates (:func:`two_level_probe`) — ~5x fewer
+    centroid-scoring FLOPs than the flat scan at 65k centroids
+    (:func:`probe_flop_accounting`), recall guarded by the ``overprobe``
+    knob and audited by :func:`coarse_probe_recall`.
+    """
+
+    super_cents: jax.Array   # (n_super, d) f32 super-centroids
+    member_ids: jax.Array    # (n_super, max_members) int32, sentinel n_cents
+    cents_padded: jax.Array  # (n_super, max_members, d) f32 member rows
+    n_cents: int = dataclasses.field(metadata=dict(static=True))
+    n_super: int = dataclasses.field(metadata=dict(static=True))
+    max_members: int = dataclasses.field(metadata=dict(static=True))
+    # the caller-facing build arguments (n_super, member_cap,
+    # kmeans_n_iters, seed) as PASSED — None where defaulted — so a
+    # rebuild over a different centroid set (expand_probe_set) replays
+    # the user's tuning instead of silently reverting to defaults while
+    # scale-dependent defaults still re-derive
+    build_args: tuple = dataclasses.field(
+        default=(None, None, 10, 0), metadata=dict(static=True)
+    )
+
+
+def default_coarse_geometry(n_cents: int):
+    """(n_super, member_cap) defaults: ~sqrt(n_cents) super clusters,
+    members capped at ceil(1.5 x mean) via the shared oversized-list
+    split — the cap bounds ``max_members`` so the probe-FLOP win holds
+    under cluster skew (a swollen super cluster would tax every probe's
+    rectangular member gather, exactly the padded-list tax)."""
+    n_super = max(1, min(n_cents, int(round(n_cents ** 0.5))))
+    mean = -(-n_cents // n_super)
+    return n_super, max(8, -(-3 * mean // 2))
+
+
+def n_super_probes(n_probes: int, n_super: int,
+                   overprobe: float = 2.0) -> int:
+    """How many super clusters a two-level probe scans: ``ceil(overprobe
+    x n_probes)``, clamped to the super count. With ``overprobe >= 1``
+    (enforced) and no empty super clusters (the build drops them), the
+    selected supers always contribute >= n_probes valid candidate
+    centroids, so the reranked top-n_probes never contains a padding
+    sentinel. Small indexes degenerate exactly: once the clamp engages
+    every super is scanned and the probe equals the flat scan."""
+    from raft_tpu import errors
+
+    errors.expects(
+        overprobe >= 1.0,
+        "overprobe=%s < 1 would under-fill the candidate set (fewer "
+        "valid candidates than n_probes)", overprobe,
+    )
+    return max(1, min(n_super, int(np.ceil(overprobe * n_probes))))
+
+
+def build_coarse_index(centroids, *, n_super=None, member_cap=None,
+                       kmeans_n_iters: int = 10,
+                       seed: int = 0) -> CoarseIndex:
+    """Cluster a centroid set into a :class:`CoarseIndex` (host-side —
+    coarse-index construction is offline, like every index build).
+
+    Reuses :func:`raft_tpu.cluster.kmeans.kmeans_fit` for the super
+    clustering (bf16 compute — quantizer-training precision) and
+    :func:`split_oversized_lists` for the member cap; empty super
+    clusters are dropped so every probed super contributes candidates.
+    """
+    from raft_tpu import errors
+    from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+    cents = jnp.asarray(centroids, jnp.float32)
+    errors.expects(
+        cents.ndim == 2 and cents.shape[0] >= 1,
+        "centroids: expected a (n >= 1, d) matrix, got shape %s",
+        tuple(cents.shape),
+    )
+    build_args = (
+        None if n_super is None else int(n_super),
+        None if member_cap is None else int(member_cap),
+        int(kmeans_n_iters), int(seed),
+    )
+    n, d = cents.shape
+    ns_default, cap_default = default_coarse_geometry(n)
+    if n_super is None:
+        n_super = ns_default
+    n_super = max(1, min(int(n_super), n))
+    if member_cap is None:
+        member_cap = cap_default
+    out = kmeans_fit(
+        cents,
+        KMeansParams(
+            n_clusters=n_super, max_iter=kmeans_n_iters, seed=seed,
+            init="random", compute_dtype="bfloat16",
+        ),
+    )
+    labels = np.asarray(out.labels)
+    sup = out.centroids
+    if member_cap:
+        labels, sup = split_oversized_lists(labels, sup, int(member_cap))
+    sup_np = np.asarray(sup, np.float32)
+    ns = sup_np.shape[0]
+    sizes = np.bincount(labels, minlength=ns)
+    keep = np.nonzero(sizes > 0)[0]
+    order = np.argsort(labels, kind="stable")
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    mm = max(int(sizes.max()), 1)
+    member = np.full((keep.size, mm), n, np.int32)
+    for row, s in enumerate(keep.tolist()):
+        cnt = int(sizes[s])
+        member[row, :cnt] = order[offsets[s]:offsets[s] + cnt]
+    cents_np = np.asarray(cents, np.float32)
+    cpad = cents_np[np.minimum(member, n - 1)]
+    return CoarseIndex(
+        super_cents=jnp.asarray(sup_np[keep]),
+        member_ids=jnp.asarray(member),
+        cents_padded=jnp.asarray(cpad),
+        n_cents=n,
+        n_super=int(keep.size),
+        max_members=mm,
+        build_args=build_args,
+    )
+
+
+def two_level_probe(qf, super_cents, member_ids, cents_padded,
+                    n_cents: int, n_probes: int, n_sup_probes: int,
+                    block_q: int = 256, precision=None):
+    """Sub-linear coarse probe: score queries against the super-centroid
+    set, gather the top ``n_sup_probes`` super clusters' member blocks,
+    and exactly rerank only those candidate centroids. Returns
+    (probes (nq, p) int32, d2 (nq, p) f32 best-first candidate
+    distances) — a drop-in for step (1)-(2) of :func:`coarse_probe` at a
+    fraction of its FLOPs (:func:`probe_flop_accounting`).
+
+    Plain ops only (top_k / take / einsum at the same default matmul
+    precision as the flat scan), so the probe keeps its speed inside
+    shard_map and produces identical replicated probes on every chip.
+    Queries are processed in ``block_q`` blocks (:func:`map_query_blocks`)
+    so the (block, S·max_members, d) candidate gather stays HBM-bounded.
+    When ``n_sup_probes`` covers every super cluster the probe reranks
+    every centroid — exactly the flat scan's candidate set.
+    """
+    f32 = jnp.float32
+    qf = jnp.asarray(qf).astype(f32)
+    ns, mm, d = cents_padded.shape
+    S = max(1, min(int(n_sup_probes), ns))
+
+    def blk(qb):
+        bq = qb.shape[0]
+        sup, _ = coarse_probe(qb, super_cents, S, precision)  # (bq, S)
+        cand_ids = jnp.take(member_ids, sup, axis=0).reshape(bq, S * mm)
+        cand = jnp.take(cents_padded, sup, axis=0).reshape(bq, S * mm, d)
+        valid = cand_ids < n_cents
+        qn = jnp.sum(qb * qb, axis=1)
+        cvn = jnp.sum(cand * cand, axis=2)
+        dots = jnp.einsum(
+            "qcd,qd->qc", cand, qb, preferred_element_type=f32,
+            precision=precision,
+        )
+        d2 = jnp.where(valid, qn[:, None] + cvn - 2.0 * dots, jnp.inf)
+        vals, pos = jax.lax.top_k(-d2, n_probes)
+        probes = jnp.take_along_axis(cand_ids, pos, axis=1)
+        # a +inf slot can only surface when fewer than n_probes valid
+        # candidates exist (overprobe < 1 misuse); clamp its sentinel id
+        # so downstream owner[probe] gathers stay in range
+        probes = jnp.where(jnp.isfinite(-vals), probes, 0)
+        return -vals, probes.astype(jnp.int32)
+
+    vals, probes = map_query_blocks(blk, qf, block_q)
+    return probes, vals
+
+
+def coarse_probe_recall(queries, centroids, coarse: "CoarseIndex",
+                        n_probes: int, *, overprobe: float = 2.0,
+                        block_q: int = 256) -> float:
+    """The two-level probe's recall guardrail: fraction of the flat
+    scan's probed lists the two-level probe reproduces on ``queries``
+    (eager, host sync — an audit, not a serving-path call). Bench
+    workloads must stay within 0.01 of the flat probe; sweep
+    ``overprobe`` up when they don't."""
+    qf = jnp.asarray(queries, jnp.float32)
+    flat, _ = coarse_probe(qf, jnp.asarray(centroids, jnp.float32),
+                           n_probes)
+    S = n_super_probes(n_probes, coarse.n_super, overprobe)
+    two, _ = two_level_probe(
+        qf, coarse.super_cents, coarse.member_ids, coarse.cents_padded,
+        coarse.n_cents, n_probes, S, block_q,
+    )
+    a, b = np.asarray(flat), np.asarray(two)
+    hits = sum(
+        len(set(x.tolist()) & set(y.tolist())) for x, y in zip(a, b)
+    )
+    return hits / a.size
+
+
+def probe_flop_accounting(coarse: "CoarseIndex", n_probes: int, *,
+                          overprobe: float = 2.0) -> dict:
+    """Per-query centroid-scoring MAC counts, from shapes alone:
+    ``flat`` = the brute scan over all n_cents centroids, ``two_level`` =
+    super scan + worst-case member rerank. The acceptance check for the
+    two-level probe (>= 4x fewer FLOPs at ~65k centroids) reads
+    ``ratio`` from here."""
+    d = coarse.super_cents.shape[1]
+    S = n_super_probes(n_probes, coarse.n_super, overprobe)
+    flat = 2.0 * coarse.n_cents * d
+    two = 2.0 * (coarse.n_super + S * coarse.max_members) * d
+    return {"flat": flat, "two_level": two, "ratio": flat / two}
 
 
 def coarse_probe(qf, centroids, n_probes: int, precision=None):
@@ -271,12 +492,35 @@ class _AuditRegistry:
 _THROUGHPUT_AUDITED = _AuditRegistry()
 
 
+def _eager_probe(q, centroids, n_probes: int, coarse=None,
+                 overprobe: float = 2.0):
+    """The eager (qcap-sizing / audit) probe: the two-level probe when a
+    :class:`CoarseIndex` is supplied — the flat scan it replaces costs
+    exactly the ~65k-centroid matmul the coarse index exists to avoid,
+    and the drop stats should reflect the probe map actually served —
+    else the flat scan."""
+    qf = jnp.asarray(q, jnp.float32)
+    if coarse is not None:
+        probes, _ = two_level_probe(
+            qf, coarse.super_cents, coarse.member_ids,
+            coarse.cents_padded, coarse.n_cents, n_probes,
+            n_super_probes(n_probes, coarse.n_super, overprobe),
+        )
+        return probes
+    probes, _ = coarse_probe(qf, centroids, n_probes)
+    return probes
+
+
 def resolve_qcap_arg(qcap, q, centroids, n_lists: int, n_probes: int,
-                     max_drop_frac=None):
+                     max_drop_frac=None, coarse=None,
+                     overprobe: float = 2.0):
     """Shared qcap-argument resolution of every grouped search entry
     point: ``None`` -> the recall-safe auto path (:func:`auto_qcap`),
     ``"throughput"`` -> :func:`throughput_qcap`, an integer -> as-is.
-    Returns (qcap int, probes_or_none).
+    Returns (qcap int, probes_or_none). ``coarse``/``overprobe``: the
+    eager sizing/audit probes route through the two-level probe when the
+    caller's index carries one (:func:`_eager_probe`) — the auto paths
+    must not reintroduce the flat scan the coarse index removes.
 
     ``qcap="throughput"`` guardrail (VERDICT r4 weak-4: the mode
     measured a silent 0.27 recall cost on a rank-concentrated 3M x 768
@@ -307,9 +551,7 @@ def resolve_qcap_arg(qcap, q, centroids, n_lists: int, n_probes: int,
             return qc, None
         from raft_tpu.core import logger
 
-        probes, _ = coarse_probe(
-            jnp.asarray(q, jnp.float32), centroids, n_probes
-        )
+        probes = _eager_probe(q, centroids, n_probes, coarse, overprobe)
         stats = probe_drop_stats(probes, n_lists, qc)
         _THROUGHPUT_AUDITED.add(centroids, sig)
         if max_drop_frac is not None and stats["frac"] > max_drop_frac:
@@ -339,7 +581,10 @@ def resolve_qcap_arg(qcap, q, centroids, n_lists: int, n_probes: int,
         # whole grouped program with an extra traced argument
         return qc, None
     if qcap is None:
-        return auto_qcap(q, centroids, n_lists, n_probes)
+        return auto_qcap(
+            q, centroids, n_lists, n_probes, coarse=coarse,
+            overprobe=overprobe,
+        )
     errors.expects(
         isinstance(qcap, (int, np.integer)) and not isinstance(qcap, bool),
         "qcap must be an int, None, or 'throughput'; got %r", qcap,
@@ -397,13 +642,15 @@ def resolve_qcap(probes, n_lists: int, nq: int, n_probes: int,
     return qcap
 
 
-def auto_qcap(q, centroids, n_lists: int, n_probes: int):
-    """Shared qcap=None path of the grouped searches: eagerly probe, size
+def auto_qcap(q, centroids, n_lists: int, n_probes: int, coarse=None,
+              overprobe: float = 2.0):
+    """Shared qcap=None path of the grouped searches: eagerly probe
+    (two-level when ``coarse`` is supplied — :func:`_eager_probe`), size
     qcap from the actual map (:func:`resolve_qcap`), and hand the probes
     back for reuse — or None under an outer jit, where the impl must
     recompute them. Returns (qcap, probes_or_none)."""
     nq = q.shape[0]
-    probes, _ = coarse_probe(q.astype(jnp.float32), centroids, n_probes)
+    probes = _eager_probe(q, centroids, n_probes, coarse, overprobe)
     qcap = resolve_qcap(probes, n_lists, nq, n_probes)
     if isinstance(probes, jax.core.Tracer):
         return qcap, None

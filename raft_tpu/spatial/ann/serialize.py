@@ -7,7 +7,7 @@ makes persistence a practical necessity, so raft_tpu provides it
 natively: one ``.npz`` per index, arrays + a small JSON header carrying
 the static fields. Loading returns device-resident pytrees.
 
-Format (v2): numpy ``.npz`` with keys ``__header__`` (JSON: index type,
+Format (v3): numpy ``.npz`` with keys ``__header__`` (JSON: index type,
 version, static fields, integrity manifest) and one entry per array
 leaf. Portable across hosts; no pickle. The integrity manifest stamps
 each array's CRC32/shape/dtype at save time; ``load_index`` verifies
@@ -16,7 +16,11 @@ every array against it and raises a structured
 instead of returning garbage — at serving scale a checkpoint that sat
 on disk through a torn write or bit-rot must fail loudly at load, not
 as silently wrong neighbors (docs/robustness.md "Checkpoint
-integrity"). v1 files (no manifest) still load.
+integrity"). v3 adds the sharded indexes' optional two-level coarse
+quantizer (:class:`raft_tpu.spatial.ann.common.CoarseIndex`, nested
+under ``coarse.*`` keys and CRC-manifested like every other array);
+v2 files (no coarse quantizer) and v1 files (no manifest either) still
+load — ``coarse`` comes back ``None``.
 """
 
 from __future__ import annotations
@@ -31,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import errors
-from raft_tpu.spatial.ann.common import ListStorage
+from raft_tpu.spatial.ann.common import CoarseIndex, ListStorage
 from raft_tpu.spatial.ann.ivf_flat import IVFFlatIndex
 from raft_tpu.spatial.ann.ivf_pq import IVFPQIndex
 from raft_tpu.spatial.ann.ivf_sq import IVFSQIndex
@@ -39,9 +43,10 @@ from raft_tpu.sparse.distance import SparseColBlockIndex
 
 __all__ = ["save_index", "load_index"]
 
-_VERSION = 2
-# v1 = no integrity manifest (read-compat: loads without verification)
-_READABLE_VERSIONS = (1, 2)
+_VERSION = 3
+# v1 = no integrity manifest (read-compat: loads without verification);
+# v2 = manifest but no two-level coarse quantizer (loads, coarse=None)
+_READABLE_VERSIONS = (1, 2, 3)
 
 _TYPES = {
     "ivf_flat": IVFFlatIndex,
@@ -67,7 +72,7 @@ def _register_sharded() -> None:
 
 _NAMES = {v: k for k, v in _TYPES.items()}
 # nested dataclasses that may appear inside an index payload
-_NESTED = {"ListStorage": ListStorage}
+_NESTED = {"ListStorage": ListStorage, "CoarseIndex": CoarseIndex}
 
 
 def _flatten(obj: Any, prefix: str, arrays: dict, static: dict) -> None:
@@ -107,9 +112,13 @@ def _array_crc(arr: np.ndarray) -> int:
 
 
 def save_index(index, path) -> None:
-    """Serialize an ANN / sparse index to ``path`` (``.npz``, format v2:
-    the header carries a per-array CRC32/shape/dtype integrity manifest
-    that :func:`load_index` verifies)."""
+    """Serialize an ANN / sparse index to ``path`` (``.npz``; the header
+    carries a per-array CRC32/shape/dtype integrity manifest that
+    :func:`load_index` verifies). The stamped version is the LOWEST one
+    that can represent the payload — v3 only when a two-level coarse
+    quantizer is attached, v2 otherwise — so checkpoints without the new
+    field stay loadable by previous releases (rollback/mixed-version
+    fleets)."""
     if type(index) not in _NAMES:
         _register_sharded()
     errors.expects(
@@ -120,6 +129,14 @@ def save_index(index, path) -> None:
     arrays: dict = {}
     static: dict = {}
     _flatten(index, "", arrays, static)
+    version = (
+        _VERSION
+        if any(
+            isinstance(v, dict) and v.get("__nested__") == "CoarseIndex"
+            for v in static.values()
+        )
+        else 2
+    )
     # manifest over the bytes actually archived (post bfloat16->uint16
     # view), so verification needs no dtype knowledge to run
     integrity = {
@@ -132,7 +149,7 @@ def save_index(index, path) -> None:
     }
     header = {
         "type": _NAMES[type(index)],
-        "version": _VERSION,
+        "version": version,
         "static": static,
         "integrity": integrity,
     }
@@ -164,7 +181,7 @@ class _VerifiedArchive:
     Every read is checked two ways: container-level damage (a zip member
     that no longer decodes — zipfile CRC failures, torn npy headers)
     converts to :class:`CorruptIndexError` naming the field, and for
-    format v2 the decoded bytes are verified against the header's
+    format v2+ the decoded bytes are verified against the header's
     CRC32/shape/dtype manifest — which catches SILENT corruption the
     container cannot (a rewritten archive whose zip CRCs match the
     damaged payload; see raft_tpu.testing.faults.corrupt_bytes).
@@ -231,7 +248,9 @@ def _rebuild(cls, prefix: str, npz, static: dict, placer=_default_placer):
                     "load_index: unknown nested type %r", v["__nested__"],
                 )
                 nested_cls = _NESTED[v["__nested__"]]
-                kwargs[f.name] = _rebuild(nested_cls, key + ".", npz, static)
+                kwargs[f.name] = _rebuild(
+                    nested_cls, key + ".", npz, static, placer
+                )
             elif isinstance(v, list):
                 kwargs[f.name] = tuple(v)
             else:
@@ -240,7 +259,7 @@ def _rebuild(cls, prefix: str, npz, static: dict, placer=_default_placer):
 
 
 def load_index(path, comms=None):
-    """Load an index saved by :func:`save_index`, verifying the format-v2
+    """Load an index saved by :func:`save_index`, verifying the v2+
     integrity manifest; arrays land on the default device. Damage — an
     unreadable archive/header, a field that fails its CRC32, a
     shape/dtype that disagrees with the manifest — raises

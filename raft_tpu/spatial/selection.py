@@ -23,7 +23,10 @@ from jax import lax
 
 from raft_tpu import errors
 
-__all__ = ["SelectKAlgo", "select_k", "select_k_blocked", "merge_topk"]
+__all__ = [
+    "SelectKAlgo", "merge_parts_select_k", "merge_topk", "select_k",
+    "select_k_blocked",
+]
 
 
 class SelectKAlgo(enum.IntEnum):
@@ -125,6 +128,38 @@ def chunk_min_select_k(dists, k: int, *, select_min: bool = True,
     nv, p = lax.top_k(-flat if select_min else flat, k)
     which = jnp.take_along_axis(cidx, p // chunk, axis=1)
     return (-nv if select_min else nv), which * chunk + p % chunk
+
+
+def merge_parts_select_k(part_vals, part_ids, k: int, *, ways=None,
+                         select_min: bool = True):
+    """k-way merge of per-part top-k payloads in one :func:`select_k`
+    call — the reference's ``knn_merge_parts``
+    (knn_brute_force_faiss.cuh:289-368) as the sharded engines'
+    IN-PROGRAM cross-shard merge tail (each part's ids are already
+    global; the payloads arrive from one comms allgather).
+
+    ``part_vals`` / ``part_ids``: (P, nq, kk) stacked per-part results.
+    ``ways``: pad the part axis with +inf/-1 (worst-value / invalid)
+    absent-peer entries up to this many parts before selecting — the
+    merge then runs at a DEPLOYMENT's width on a smaller mesh with
+    bit-identical results (an absent peer contributes nothing, the same
+    contract as a down shard). Returns (vals (nq, k), ids (nq, k)),
+    best-first.
+    """
+    n_parts, nq, kk = part_vals.shape
+    if ways is not None and ways > n_parts:
+        extra = ways - n_parts
+        fill = jnp.inf if select_min else -jnp.inf
+        part_vals = jnp.concatenate(
+            [part_vals,
+             jnp.full((extra, nq, kk), fill, part_vals.dtype)]
+        )
+        part_ids = jnp.concatenate(
+            [part_ids, jnp.full((extra, nq, kk), -1, part_ids.dtype)]
+        )
+    flat_v = part_vals.transpose(1, 0, 2).reshape(nq, -1)
+    flat_i = part_ids.transpose(1, 0, 2).reshape(nq, -1)
+    return select_k(flat_v, k, select_min=select_min, indices=flat_i)
 
 
 def merge_topk(vals_a, idx_a, vals_b, idx_b, *, select_min: bool = True):

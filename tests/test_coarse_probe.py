@@ -1,0 +1,472 @@
+"""Two-level coarse probe (spatial/ann/common.CoarseIndex) + the
+in-program cross-shard merge width (``merge_ways=``) — the r6 serving
+tentpole:
+
+* build invariants: member blocks PARTITION the centroid set, no empty
+  super clusters, the member cap bounds ``max_members``;
+* exact degeneration: when every super cluster is scanned the two-level
+  probe selects exactly the flat scan's probe set;
+* the FLOP acceptance: >= 4x fewer centroid-scoring FLOPs than the flat
+  scan at the deployment-scale ~65k-centroid geometry (shape
+  accounting), with probe recall within the guardrail on clustered data;
+* ``merge_ways`` pads the in-program allgather+select_k merge to
+  deployment width with IDENTICAL results (absent peers contribute
+  +inf/-1);
+* serialize format v3 carries the coarse index (CRC-manifested,
+  v2-shaped archives still load with ``coarse=None``).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.spatial.ann import common as ann_common
+from raft_tpu.spatial.ann.common import (
+    CoarseIndex,
+    build_coarse_index,
+    coarse_probe,
+    coarse_probe_recall,
+    default_coarse_geometry,
+    n_super_probes,
+    probe_flop_accounting,
+    two_level_probe,
+)
+
+
+@pytest.fixture(scope="module")
+def centroid_set():
+    rng = np.random.default_rng(11)
+    return rng.standard_normal((300, 16)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def coarse(centroid_set):
+    return build_coarse_index(centroid_set, seed=0)
+
+
+class TestBuild:
+    def test_members_partition_the_centroids(self, coarse, centroid_set):
+        n = centroid_set.shape[0]
+        m = np.asarray(coarse.member_ids)
+        real = m[m < n]
+        assert sorted(real.tolist()) == list(range(n))
+        # padding is exactly the sentinel
+        assert (m[m >= n] == n).all()
+        assert coarse.n_cents == n
+
+    def test_no_empty_super_clusters(self, coarse, centroid_set):
+        n = centroid_set.shape[0]
+        m = np.asarray(coarse.member_ids)
+        assert ((m < n).sum(axis=1) >= 1).all()
+
+    def test_padded_blocks_carry_member_rows(self, coarse, centroid_set):
+        n = centroid_set.shape[0]
+        m = np.asarray(coarse.member_ids)
+        cpad = np.asarray(coarse.cents_padded)
+        valid = m < n
+        np.testing.assert_allclose(
+            cpad[valid], centroid_set[m[valid]], rtol=1e-6
+        )
+
+    def test_member_cap_bounds_max_members(self, centroid_set):
+        ci = build_coarse_index(centroid_set, member_cap=16, seed=0)
+        assert ci.max_members <= 16
+        # still a partition after splitting
+        m = np.asarray(ci.member_ids)
+        real = m[m < 300]
+        assert sorted(real.tolist()) == list(range(300))
+
+    def test_geometry_defaults(self):
+        ns, cap = default_coarse_geometry(65792)
+        assert ns == 256
+        mean = -(-65792 // ns)
+        assert cap == -(-3 * mean // 2)
+
+    def test_overprobe_below_one_rejected(self):
+        with pytest.raises(ValueError):
+            n_super_probes(8, 64, overprobe=0.5)
+
+
+class TestProbe:
+    def test_full_cover_matches_flat_scan(self, coarse, centroid_set):
+        """S = n_super reranks every centroid — the probe set must equal
+        the flat scan's exactly (the small-index degeneration that makes
+        two-level a safe default at any scale)."""
+        rng = np.random.default_rng(3)
+        q = rng.standard_normal((32, 16)).astype(np.float32)
+        flat, _ = coarse_probe(jnp.asarray(q), jnp.asarray(centroid_set), 8)
+        two, d2 = two_level_probe(
+            q, coarse.super_cents, coarse.member_ids, coarse.cents_padded,
+            coarse.n_cents, 8, coarse.n_super,
+        )
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(flat), axis=1),
+            np.sort(np.asarray(two), axis=1),
+        )
+        assert np.isfinite(np.asarray(d2)).all()
+
+    def test_probe_respects_query_blocking(self, coarse, centroid_set):
+        """block_q smaller than nq must not change the probe set."""
+        rng = np.random.default_rng(4)
+        q = rng.standard_normal((21, 16)).astype(np.float32)
+        args = (coarse.super_cents, coarse.member_ids,
+                coarse.cents_padded, coarse.n_cents, 6, coarse.n_super)
+        a, _ = two_level_probe(q, *args, 256)
+        b, _ = two_level_probe(q, *args, 4)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_recall_guardrail_on_clustered_data(self):
+        """Clustered centroids (the bench regime): two-level probe recall
+        vs the flat scan stays high at the default overprobe."""
+        rng = np.random.default_rng(9)
+        hubs = 8.0 * rng.standard_normal((64, 12)).astype(np.float32)
+        cents = (
+            np.repeat(hubs, 32, axis=0)
+            + rng.standard_normal((2048, 12)).astype(np.float32)
+        )
+        ci = build_coarse_index(cents, seed=1)
+        assert ci.n_super > n_super_probes(8, ci.n_super), \
+            "test premise: the probe must actually be sub-linear here"
+        q = cents[::97][:20] + 0.1 * rng.standard_normal(
+            (20, 12)
+        ).astype(np.float32)
+        rec = coarse_probe_recall(q, cents, ci, 8)
+        assert rec >= 0.95
+
+    def test_flop_acceptance_at_deployment_geometry(self):
+        """THE acceptance: >= 4x fewer centroid-scoring FLOPs than the
+        flat scan at n_gcents ~ 65k, by shape accounting — even at the
+        worst-case geometry the defaults allow (member blocks full to
+        the cap, super count inflated by every possible cap split)."""
+        n_cents, d, n_probes = 65792, 96, 16
+        ns, cap = default_coarse_geometry(n_cents)
+        # cap splitting can only ADD ceil(n/cap) supers beyond the base
+        worst_ns = ns + -(-n_cents // cap)
+        worst = CoarseIndex(
+            super_cents=jnp.zeros((worst_ns, d), jnp.float32),
+            member_ids=jnp.zeros((worst_ns, cap), jnp.int32),
+            cents_padded=jnp.zeros((worst_ns, cap, d), jnp.float32),
+            n_cents=n_cents, n_super=worst_ns, max_members=cap,
+        )
+        acc = probe_flop_accounting(worst, n_probes)
+        assert acc["ratio"] >= 4.0, acc
+
+    def test_flop_accounting_matches_built_geometry(self, coarse):
+        acc = probe_flop_accounting(coarse, 8, overprobe=2.0)
+        d = coarse.super_cents.shape[1]
+        S = n_super_probes(8, coarse.n_super, 2.0)
+        assert acc["flat"] == 2.0 * coarse.n_cents * d
+        assert acc["two_level"] == 2.0 * (
+            coarse.n_super + S * coarse.max_members
+        ) * d
+
+
+# ---------------------------------------------------------------------------
+# Sharded engines: fused two-level probe + deployment-width merge
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def comms8():
+    from raft_tpu.comms import build_comms
+
+    return build_comms(jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def sharded_data():
+    rng = np.random.default_rng(21)
+    x = rng.standard_normal((640, 16)).astype(np.float32)
+    q = x[::41][:10] + 0.05 * rng.standard_normal((10, 16)).astype(
+        np.float32
+    )
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def sharded_flat(comms8, sharded_data):
+    from raft_tpu.comms import mnmg_ivf_flat_build
+    from raft_tpu.spatial.ann import IVFFlatParams
+
+    return mnmg_ivf_flat_build(
+        comms8, sharded_data[0],
+        IVFFlatParams(n_lists=8, kmeans_n_iters=4, seed=3),
+    )
+
+
+class TestShardedCoarseProbe:
+    def test_attach_and_search_parity(self, comms8, sharded_data,
+                                      sharded_flat):
+        from raft_tpu.comms import attach_coarse_index, mnmg_ivf_flat_search
+
+        _, q = sharded_data
+        cidx = attach_coarse_index(sharded_flat)
+        assert cidx.coarse is not None
+        v0, i0 = mnmg_ivf_flat_search(
+            comms8, sharded_flat, q, 5, n_probes=8, qcap=q.shape[0]
+        )
+        v1, i1 = mnmg_ivf_flat_search(
+            comms8, cidx, q, 5, n_probes=8, qcap=q.shape[0]
+        )
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(v0), np.asarray(v1),
+                                   rtol=1e-5)
+
+    def test_stale_coarse_index_rejected(self, comms8, sharded_data,
+                                         sharded_flat):
+        import dataclasses
+
+        from raft_tpu.comms import attach_coarse_index, mnmg_ivf_flat_search
+
+        _, q = sharded_data
+        cidx = attach_coarse_index(sharded_flat)
+        # manually widening the probe set WITHOUT rebuilding the coarse
+        # index must fail loudly, not probe a stale subset
+        bad = dataclasses.replace(
+            cidx,
+            centroids=jnp.concatenate(
+                [jnp.asarray(cidx.centroids),
+                 jnp.zeros((4, 16), jnp.float32)]
+            ),
+            owner=jnp.concatenate(
+                [jnp.asarray(cidx.owner),
+                 jnp.full((4,), -1, jnp.int32)]
+            ),
+            local_id=jnp.concatenate(
+                [jnp.asarray(cidx.local_id), jnp.zeros((4,), jnp.int32)]
+            ),
+        )
+        with pytest.raises(ValueError, match="coarse index"):
+            mnmg_ivf_flat_search(comms8, bad, q, 5, n_probes=8,
+                                 qcap=q.shape[0])
+
+    def test_merge_ways_identical_results(self, comms8, sharded_data,
+                                          sharded_flat):
+        """The in-program merge at deployment width: absent peers pad
+        the allgathered payload with +inf/-1, so the 16-way select_k
+        returns exactly the 8-way answer."""
+        from raft_tpu.comms import mnmg_ivf_flat_search
+
+        _, q = sharded_data
+        v0, i0 = mnmg_ivf_flat_search(
+            comms8, sharded_flat, q, 5, n_probes=8, qcap=q.shape[0]
+        )
+        v1, i1 = mnmg_ivf_flat_search(
+            comms8, sharded_flat, q, 5, n_probes=8, qcap=q.shape[0],
+            merge_ways=16,
+        )
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(v0), np.asarray(v1),
+                                   rtol=1e-6)
+
+    def test_merge_ways_narrower_than_mesh_rejected(self, comms8,
+                                                    sharded_data,
+                                                    sharded_flat):
+        from raft_tpu.comms import mnmg_ivf_flat_search
+
+        _, q = sharded_data
+        with pytest.raises(ValueError, match="merge_ways"):
+            mnmg_ivf_flat_search(
+                comms8, sharded_flat, q, 5, n_probes=8, qcap=q.shape[0],
+                merge_ways=4,
+            )
+
+    def test_merge_ways_pq_engine(self, comms8, sharded_data):
+        from raft_tpu.comms import (
+            attach_coarse_index, mnmg_ivf_pq_build, mnmg_ivf_pq_search,
+        )
+        from raft_tpu.spatial.ann import IVFPQParams
+
+        x, q = sharded_data
+        idx = mnmg_ivf_pq_build(
+            comms8, x,
+            IVFPQParams(n_lists=8, pq_dim=4, kmeans_n_iters=3, seed=5),
+        )
+        v0, i0 = mnmg_ivf_pq_search(comms8, idx, q, 5, n_probes=8,
+                                    qcap=q.shape[0])
+        cidx = attach_coarse_index(idx)
+        v1, i1 = mnmg_ivf_pq_search(
+            comms8, cidx, q, 5, n_probes=8, qcap=q.shape[0],
+            merge_ways=16,
+        )
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_warmup_covers_coarse_and_merge_ways(self, comms8,
+                                                 sharded_data,
+                                                 sharded_flat):
+        from raft_tpu.comms import attach_coarse_index
+
+        _, q = sharded_data
+        cidx = attach_coarse_index(sharded_flat)
+        qc = cidx.warmup(
+            comms8, q.shape[0], k=5, n_probes=8, merge_ways=16
+        )
+        assert isinstance(qc, int) and qc >= 1
+
+
+# ---------------------------------------------------------------------------
+# Serialize format v3
+# ---------------------------------------------------------------------------
+
+
+class TestSerializeV3:
+    def test_roundtrip_carries_coarse_with_manifest(
+        self, comms8, sharded_data, sharded_flat, tmp_path
+    ):
+        from raft_tpu.comms import attach_coarse_index, mnmg_ivf_flat_search
+        from raft_tpu.spatial.ann import load_index, save_index
+
+        _, q = sharded_data
+        cidx = attach_coarse_index(sharded_flat)
+        p = tmp_path / "v3.npz"
+        save_index(cidx, p)
+        with np.load(p) as npz:
+            header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
+        assert header["version"] == 3
+        assert header["static"]["coarse"] == {"__nested__": "CoarseIndex"}
+        # the coarse arrays are CRC-manifested like every other array
+        for key in ("coarse.super_cents", "coarse.member_ids",
+                    "coarse.cents_padded"):
+            assert key in header["integrity"]
+        loaded = load_index(p, comms=comms8)
+        assert loaded.coarse is not None
+        assert loaded.coarse.n_super == cidx.coarse.n_super
+        v0, i0 = mnmg_ivf_flat_search(
+            comms8, cidx, q, 5, n_probes=8, qcap=q.shape[0]
+        )
+        v1, i1 = mnmg_ivf_flat_search(
+            comms8, loaded, q, 5, n_probes=8, qcap=q.shape[0]
+        )
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_corrupt_coarse_array_names_the_field(
+        self, sharded_flat, tmp_path
+    ):
+        from raft_tpu import errors
+        from raft_tpu.comms import attach_coarse_index
+        from raft_tpu.spatial.ann import load_index, save_index
+        from raft_tpu.testing import faults
+
+        cidx = attach_coarse_index(sharded_flat)
+        p = tmp_path / "v3.npz"
+        save_index(cidx, p)
+        damaged = faults.corrupt_bytes(
+            p, field="coarse.super_cents", seed=2
+        )
+        assert damaged == "coarse.super_cents"
+        with pytest.raises(
+            errors.CorruptIndexError, match="coarse.super_cents"
+        ) as ei:
+            load_index(p)
+        assert ei.value.field == "coarse.super_cents"
+
+    def test_v2_shaped_archive_loads_without_coarse(
+        self, sharded_flat, tmp_path
+    ):
+        """Read-compat: an archive written before the coarse quantizer
+        existed (version 2, no coarse.* keys) loads with coarse=None."""
+        from raft_tpu.spatial.ann import load_index, serialize
+
+        arrays, static = {}, {}
+        serialize._flatten(sharded_flat, "", arrays, static)
+        assert sharded_flat.coarse is None and static["coarse"] is None
+        static.pop("coarse")          # a v2 writer never knew the field
+        integrity = {
+            key: {
+                "crc32": serialize._array_crc(arr),
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            }
+            for key, arr in arrays.items()
+        }
+        header = {"type": "mnmg_ivf_flat", "version": 2,
+                  "static": static, "integrity": integrity}
+        p = tmp_path / "v2.npz"
+        with open(p, "wb") as f:
+            np.savez(
+                f,
+                __header__=np.frombuffer(
+                    json.dumps(header).encode("utf-8"), dtype=np.uint8
+                ),
+                **arrays,
+            )
+        idx = load_index(p)
+        assert idx.coarse is None
+        np.testing.assert_allclose(
+            np.asarray(idx.centroids), np.asarray(sharded_flat.centroids)
+        )
+
+    def test_reshard_preserves_coarse(self, comms8, sharded_flat):
+        from raft_tpu.comms import attach_coarse_index, place_index
+        from raft_tpu.comms import build_comms
+
+        cidx = attach_coarse_index(sharded_flat)
+        comms4 = build_comms(jax.devices()[:4])
+        idx4 = place_index(comms4, cidx)
+        assert idx4.sorted_ids.shape[0] == 4
+        assert idx4.coarse is not None
+        np.testing.assert_allclose(
+            np.asarray(idx4.coarse.super_cents),
+            np.asarray(cidx.coarse.super_cents),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Registry hygiene for the audit helpers used above
+# ---------------------------------------------------------------------------
+
+
+def test_expand_probe_set_replays_coarse_build_args(comms8, sharded_flat):
+    """Rebuilding over the expanded probe set must replay the user's
+    attach_coarse_index tuning (recorded in CoarseIndex.build_args), not
+    silently revert to defaults."""
+    from raft_tpu.comms import attach_coarse_index, expand_probe_set
+
+    cidx = attach_coarse_index(
+        sharded_flat, member_cap=2, kmeans_n_iters=5, seed=9
+    )
+    assert cidx.coarse.build_args == (None, 2, 5, 9)
+    assert cidx.coarse.max_members <= 2
+    far = (1e4 + np.arange(64)[:, None] * np.ones((64, 16))).astype(
+        np.float32
+    )
+    eidx = expand_probe_set(cidx, far)
+    assert eidx.coarse.build_args == (None, 2, 5, 9)
+    assert eidx.coarse.max_members <= 2
+    assert eidx.coarse.n_cents == 8 + 64
+
+
+def test_auto_qcap_routes_through_two_level_probe(centroid_set, coarse,
+                                                  monkeypatch):
+    """The qcap=None auto path must not reintroduce the flat centroid
+    scan the coarse index removes: with ``coarse`` supplied, every eager
+    probe matmul runs against the SUPER set only."""
+    seen = []
+    orig = ann_common.coarse_probe
+
+    def recording(qf, cents, n_probes, precision=None):
+        seen.append(int(cents.shape[0]))
+        return orig(qf, cents, n_probes, precision)
+
+    monkeypatch.setattr(ann_common, "coarse_probe", recording)
+    rng = np.random.default_rng(6)
+    q = rng.standard_normal((16, 16)).astype(np.float32)
+    qc, probes = ann_common.resolve_qcap_arg(
+        None, q, jnp.asarray(centroid_set), 300, 4, coarse=coarse
+    )
+    assert isinstance(qc, int) and qc >= 1
+    assert seen and all(s == coarse.n_super for s in seen), seen
+
+
+def test_two_level_probe_plays_with_throughput_audit(centroid_set):
+    """resolve_qcap_arg's eager audit keeps using the flat probe for
+    drop-fraction sizing — a coarse-equipped index must not break it."""
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal((16, 16)).astype(np.float32)
+    qc, probes = ann_common.resolve_qcap_arg(
+        "throughput", q, jnp.asarray(centroid_set), 300, 4
+    )
+    assert isinstance(qc, int) and qc >= 1 and probes is None

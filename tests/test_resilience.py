@@ -263,6 +263,19 @@ def flat_index(comms8, dataset):
     return mnmg_ivf_flat_build(comms8, x, FLAT_PARAMS)
 
 
+@pytest.fixture(scope="module", params=["flat_probe", "two_level_probe"])
+def probed_index(request, flat_index):
+    """The degraded-search suite runs under BOTH coarse probes: the flat
+    centroid scan and the two-level CoarseIndex probe must produce
+    identical PartialSearchResult semantics (shard_mask with a down
+    rank, owner=-1 probe-set extras, NaN query rows)."""
+    if request.param == "two_level_probe":
+        from raft_tpu.comms import attach_coarse_index
+
+        return attach_coarse_index(flat_index, seed=0)
+    return flat_index
+
+
 def _rank_row_ids(index, rank):
     """GLOBAL row ids owned by ``rank`` (host-side, from the slab
     layout: the valid region is [0, list_offsets[rank, -1]))."""
@@ -271,13 +284,13 @@ def _rank_row_ids(index, rank):
     return sids[rank, : offs[rank, -1]]
 
 
-def test_all_up_mask_matches_healthy_search(comms8, dataset, flat_index):
+def test_all_up_mask_matches_healthy_search(comms8, dataset, probed_index):
     x, q = dataset
     v0, i0 = mnmg_ivf_flat_search(
-        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0]
+        comms8, probed_index, q, K, n_probes=8, qcap=q.shape[0]
     )
     res = mnmg_ivf_flat_search(
-        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0],
+        comms8, probed_index, q, K, n_probes=8, qcap=q.shape[0],
         shard_mask=True,
     )
     assert isinstance(res, PartialSearchResult)
@@ -291,7 +304,7 @@ def test_all_up_mask_matches_healthy_search(comms8, dataset, flat_index):
 
 
 def test_fail_rank_matches_surviving_shard_search(
-    comms8, dataset, flat_index
+    comms8, dataset, probed_index
 ):
     """THE degraded-search acceptance: with rank r down and every list
     probed, the partial result's valid entries exactly equal the exact
@@ -299,11 +312,11 @@ def test_fail_rank_matches_surviving_shard_search(
     x, q = dataset
     # pick a rank that owns rows (they all do under LPT balance)
     dead = 2
-    dead_ids = set(_rank_row_ids(flat_index, dead).tolist())
+    dead_ids = set(_rank_row_ids(probed_index, dead).tolist())
     assert dead_ids, "test premise: the dead rank owns rows"
     health = faults.fail_rank(ShardHealth(8), dead)
     res = mnmg_ivf_flat_search(
-        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0],
+        comms8, probed_index, q, K, n_probes=8, qcap=q.shape[0],
         shard_mask=health,
     )
     assert res.partial is True
@@ -322,10 +335,10 @@ def test_fail_rank_matches_surviving_shard_search(
     assert not (set(got_i.ravel().tolist()) & dead_ids)
 
 
-def test_all_ranks_down_degrades_not_raises(comms8, dataset, flat_index):
+def test_all_ranks_down_degrades_not_raises(comms8, dataset, probed_index):
     _, q = dataset
     res = mnmg_ivf_flat_search(
-        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0],
+        comms8, probed_index, q, K, n_probes=8, qcap=q.shape[0],
         shard_mask=np.zeros(8, np.int32),
     )
     assert res.partial is True and res.min_coverage == 0.0
@@ -333,7 +346,7 @@ def test_all_ranks_down_degrades_not_raises(comms8, dataset, flat_index):
     assert (np.asarray(res.ids) == -1).all()
 
 
-def test_nan_rows_neutralized(comms8, dataset, flat_index):
+def test_nan_rows_neutralized(comms8, dataset, probed_index):
     """THE bad-input acceptance: poisoned rows cannot contaminate their
     batchmates — valid rows return the finite healthy answer, poisoned
     rows return the empty answer."""
@@ -342,7 +355,7 @@ def test_nan_rows_neutralized(comms8, dataset, flat_index):
     qbad = faults.inject_nonfinite(q, bad_rows, kind="nan")
     qbad = faults.inject_nonfinite(qbad, [7], kind="inf")
     res = mnmg_ivf_flat_search(
-        comms8, flat_index, qbad, K, n_probes=8, qcap=q.shape[0],
+        comms8, probed_index, qbad, K, n_probes=8, qcap=q.shape[0],
         shard_mask=True,
     )
     rv = np.asarray(res.row_valid)
@@ -356,7 +369,7 @@ def test_nan_rows_neutralized(comms8, dataset, flat_index):
     np.testing.assert_array_equal(np.asarray(res.coverage)[~rv], 0.0)
     # valid rows exactly match the healthy search of the same rows
     v0, i0 = mnmg_ivf_flat_search(
-        comms8, flat_index, q, K, n_probes=8, qcap=q.shape[0]
+        comms8, probed_index, q, K, n_probes=8, qcap=q.shape[0]
     )
     np.testing.assert_array_equal(i[rv], np.asarray(i0)[rv])
 
@@ -386,12 +399,106 @@ def test_degraded_pq_engine(comms8, dataset):
     assert not (set(live.ravel().tolist()) & dead_ids)
 
 
-def test_warmup_resilient_variant(comms8, dataset, flat_index):
+def test_warmup_resilient_variant(comms8, dataset, probed_index):
     _, q = dataset
-    qc = flat_index.warmup(
+    qc = probed_index.warmup(
         comms8, q.shape[0], k=K, n_probes=8, shard_mask=True
     )
     assert isinstance(qc, int) and qc >= 1
+
+
+def test_probe_set_extras_identical_partial_semantics(
+    comms8, dataset, probed_index
+):
+    """owner=-1 probe-set extras under a down rank: the degraded result
+    (distances, ids, coverage, row_valid) must be IDENTICAL with the
+    extras attached — unowned far-away centroids never enter any
+    query's top probes — and identical under the two-level vs flat
+    probe (expand_probe_set rebuilds an attached coarse index over the
+    expanded set)."""
+    from raft_tpu.comms import expand_probe_set
+
+    _, q = dataset
+    rng = np.random.default_rng(17)
+    far = (1e4 + rng.standard_normal((64, 16))).astype(np.float32)
+    eidx = expand_probe_set(probed_index, far)
+    # the coarse index (when present) must cover the expanded set
+    assert (eidx.coarse is not None) == (probed_index.coarse is not None)
+    if eidx.coarse is not None:
+        assert eidx.coarse.n_cents == int(eidx.owner.shape[0])
+    health = faults.fail_rank(ShardHealth(8), 3)
+    base = mnmg_ivf_flat_search(
+        comms8, probed_index, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=health,
+    )
+    res = mnmg_ivf_flat_search(
+        comms8, eidx, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=health,
+    )
+    assert isinstance(res, PartialSearchResult)
+    np.testing.assert_array_equal(
+        np.asarray(res.ids), np.asarray(base.ids)
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.distances), np.asarray(base.distances), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.coverage), np.asarray(base.coverage)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.row_valid), np.asarray(base.row_valid)
+    )
+
+
+def test_two_level_probe_health_flip_zero_retrace(
+    comms8, dataset, flat_index, monkeypatch
+):
+    """The recompile-hazard regression (trace/dispatch audit): with the
+    two-level probe engaged, flipping ``shard_mask`` values at runtime
+    triggers ZERO retraces of the compiled serving program, while
+    flipping ``overprobe`` is a trace-time static (a DIFFERENT program,
+    itself compiled once and reused across mask flips)."""
+    from raft_tpu.comms import attach_coarse_index
+    from raft_tpu.comms import mnmg_ivf_flat as mod
+
+    _, q = dataset
+    idx = attach_coarse_index(flat_index, seed=0)
+    created = []
+    orig = mod._cached_search
+
+    def recording(*a, **k):
+        fn = orig(*a, **k)
+        created.append(fn)
+        return fn
+
+    monkeypatch.setattr(mod, "_cached_search", recording)
+    kw = dict(n_probes=8, qcap=q.shape[0])
+    m_up = np.ones(8, np.int32)
+    m_one = m_up.copy()
+    m_one[3] = 0
+    m_two = m_up.copy()
+    m_two[1] = m_two[6] = 0
+    mod.mnmg_ivf_flat_search(comms8, idx, q, K, shard_mask=m_up, **kw)
+    fn = created[0]
+    size0 = fn._cache_size()
+    for mask in (m_one, m_two, m_up):
+        mod.mnmg_ivf_flat_search(comms8, idx, q, K, shard_mask=mask, **kw)
+    assert all(f is fn for f in created), \
+        "health flips must reuse the cached program object"
+    assert fn._cache_size() == size0, \
+        "health flips must not retrace the compiled program"
+    # overprobe flips at TRACE time: a distinct program...
+    mod.mnmg_ivf_flat_search(
+        comms8, idx, q, K, shard_mask=m_up, overprobe=3.0, **kw
+    )
+    fn2 = created[-1]
+    assert fn2 is not fn
+    size2 = fn2._cache_size()
+    # ...that mask flips then reuse without retracing
+    mod.mnmg_ivf_flat_search(
+        comms8, idx, q, K, shard_mask=m_one, overprobe=3.0, **kw
+    )
+    assert created[-1] is fn2 and fn2._cache_size() == size2
 
 
 # ---------------------------------------------------------------------------
@@ -408,11 +515,13 @@ def small_index():
     )
 
 
-def test_v2_roundtrip_carries_manifest(small_index, tmp_path):
+def test_roundtrip_carries_manifest(small_index, tmp_path):
     p = tmp_path / "idx.npz"
     save_index(small_index, p)
     with np.load(p) as npz:
         header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
+    # no coarse quantizer attached -> the writer stamps the LOWEST
+    # version that represents the payload (older readers keep working)
     assert header["version"] == 2
     man = header["integrity"]
     assert "data_sorted" in man and "centroids" in man

@@ -318,6 +318,46 @@ class TestBenchArtifact:
 
         assert len(json.dumps(c)) < 1800
 
+    def test_fit_line_roundtrips_and_fits_cap(self, benchtop):
+        """The r5 parsed=null regression: an over-long doc must be
+        trimmed key-by-key until the printed line json.loads-round-trips
+        under the driver cap, preserving every row's primary value."""
+        import json
+
+        doc = {
+            "metric": "pairwise", "value": 101.5, "unit": "GFLOPS",
+            "spread": 0.01, "repeats": 3,
+            "extras": [
+                {
+                    "metric": f"extra_{i}", "value": 1000.0 + i,
+                    "unit": "QPS", "spread": 0.02, "repeats": 7,
+                    "recall_at_10": 0.95, "build_s": 100.0,
+                    "build_warm_s": 2.0, "qcap8_qps": 9e4,
+                    "measured_chip_qps": 1.2e4, "sharded_e2e_qps": 1.1e4,
+                    "brute_force_same_shape_qps": 1.5e5,
+                    "vs_prev": 1.01, "vs_prev_qcap8_qps": 0.99,
+                    "vs_prev_build_warm_s": 1.0,
+                }
+                for i in range(14)
+            ],
+        }
+        line = benchtop._fit_line(doc)
+        parsed = json.loads(line)                 # round-trips
+        assert len(line) <= 1800
+        assert parsed["value"] == 101.5
+        vals = [e["value"] for e in parsed["extras"]]
+        assert vals == [1000.0 + i for i in range(14)]
+        # trimming never touches the primary regression fields
+        assert all("vs_prev" in e for e in parsed["extras"])
+
+    def test_fit_line_small_doc_untrimmed(self, benchtop):
+        import json
+
+        doc = {"metric": "m", "value": 1.0, "unit": "QPS",
+               "spread": 0.1, "repeats": 3}
+        line = benchtop._fit_line(doc)
+        assert json.loads(line) == benchtop._compact(doc)
+
     def test_vs_prev_significance_stamp(self, benchtop):
         prev = {"m": {"value": 112.0}}
         noisy = benchtop._stamp_vs_prev(
