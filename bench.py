@@ -1052,6 +1052,9 @@ _PRINT_KEYS = {
     "vs_prev_significant", "extras",
     "rows", "engine", "nq", "p50_ms", "qcap",
     "within_2x_warm",
+    # the serving resilience rows (bench/bench_serving.py): straggler
+    # p99 with/without hedging and the 2x-overload shed behavior
+    "scenario", "p99_ms", "hedged_p99_ms", "shed_rate",
 }
 
 
@@ -1129,7 +1132,7 @@ def _compact(row):
         if key not in _PRINT_KEYS and not key.startswith("vs_prev"):
             continue
         if isinstance(v, str) and key not in (
-            "metric", "unit", "error", "engine"
+            "metric", "unit", "error", "engine", "scenario"
         ):
             continue
         if isinstance(v, list) and v and isinstance(v[0], dict):

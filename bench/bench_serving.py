@@ -16,16 +16,188 @@ The serving recipe under measurement is the docs/serving.md one:
 explicit integer qcap resolved by ``index.warmup(nq)`` (no per-call
 host sync, no data-dependent re-trace), program caches warmed before
 the clock starts, one jitted program per (engine, nq).
+
+Two resilience rows ride on the IVF-Flat engine (docs/serving.md
+"Overload and shedding", docs/robustness.md "hedge-delay tuning"):
+
+* ``hedged_straggler`` — per-request latency with a deterministic
+  injected straggler (every N-th dispatch polls not-ready for ~8x p50,
+  ``faults.inject_straggler``), measured unhedged (``p99_ms``) and
+  through ``resilience.dispatch_hedged`` (``hedged_p99_ms``): the hedge
+  collapses the straggler tail toward hedge_delay + p50.
+* ``overload_2x`` — a timed open-loop arrival schedule at 2x the
+  measured sustainable rate driven through an
+  ``AdmissionController`` (bounded queue): ``p99_ms`` of ADMITTED
+  requests stays bounded at ~(max_queue+1) service times and the
+  excess load is shed with ``RaftOverloadError`` (``shed_rate``)
+  instead of collapsing the queue.
 """
 
 from __future__ import annotations
 
 import json
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NQS = (1, 128, 1024)
+
+
+def _p99(ms_list) -> float:
+    return float(np.percentile(np.asarray(ms_list), 99.0))
+
+
+def _dispatch_lat_s(run, qb, reps: int = 16):
+    lat = []
+    for i in range(reps):
+        qi = qb * (1.0 + 1e-6 * (i + 1))
+        jax.block_until_ready(qi)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(qi))
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return lat
+
+
+def _dispatch_p50_s(run, qb, reps: int = 16) -> float:
+    lat = _dispatch_lat_s(run, qb, reps)
+    return lat[len(lat) // 2]
+
+
+def hedged_straggler_row(run, qb, *, straggler_every: int = 8,
+                         n_requests: int = 64,
+                         straggler_s=None) -> dict:
+    """p99 with a periodic injected straggler, unhedged vs hedged.
+
+    ``run(q)`` is the warmed serving dispatch. Every ``straggler_every``-th
+    call is wrapped in a ``DelayedReady`` that polls not-ready for
+    ``straggler_s`` — the deterministic slow-chip schedule, identical
+    in both arms (the injector's call counter is reset between them).
+    The hedge delay is percentile-derived from measured base latencies
+    (~2x the observed p94, the docs/robustness.md tuning rule: well
+    above the NORMAL tail so jitter cannot fire spurious hedges that
+    double the load, well below the straggler so the hedge still cuts
+    it); the straggler defaults to the larger of 8x p50 and 5x the
+    hedge delay. The hedged arm backs up through the UNwrapped ``run``
+    (the real other-replica dispatch)."""
+    from raft_tpu.core.interruptible import Interruptible
+    from raft_tpu.resilience.deadline import dispatch_hedged
+    from raft_tpu.testing import faults
+
+    base = _dispatch_lat_s(run, qb)
+    p50 = base[len(base) // 2]
+    hedge_delay_s = max(0.002, 2.0 * base[-2])   # ~2x observed p94
+    straggler_s = (
+        max(0.02, 8.0 * p50, 5.0 * hedge_delay_s)
+        if straggler_s is None else straggler_s
+    )
+    wrapped, audit = faults.inject_straggler(
+        run, every=straggler_every, seconds=straggler_s
+    )
+    # warm the hedge machinery outside the measured window: one forced
+    # hedge exercises the timeout raise + wait-any path so first-call
+    # costs never land in a measured tail
+    warm, _ = faults.inject_straggler(run, every=1, seconds=0.01)
+    Interruptible.synchronize(
+        dispatch_hedged(warm, qb * (1.0 + 1e-7), hedge=0.001,
+                        backup_fn=run)
+    )
+
+    def measure(dispatch):
+        lat_ms = []
+        for i in range(n_requests):
+            qi = qb * (1.0 + 1e-6 * (i + 1))
+            jax.block_until_ready(qi)
+            t0 = time.perf_counter()
+            out = dispatch(qi)
+            Interruptible.synchronize(out)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+        return lat_ms
+
+    unhedged = measure(wrapped)
+    audit.calls = 0            # identical straggle schedule in both arms
+    hedged = measure(
+        lambda qi: dispatch_hedged(
+            wrapped, qi, hedge=hedge_delay_s, backup_fn=run,
+        )
+    )
+    return {
+        "engine": "ivf_flat",
+        "scenario": "hedged_straggler",
+        "nq": int(qb.shape[0]),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p99_ms": round(_p99(unhedged), 3),
+        "hedged_p99_ms": round(_p99(hedged), 3),
+        "hedge_delay_ms": round(hedge_delay_s * 1e3, 3),
+        "straggler_every": straggler_every,
+        "straggler_ms": round(straggler_s * 1e3, 1),
+        "n_requests": n_requests,
+    }
+
+
+def overload_row(run, qb, *, over_factor: float = 2.0,
+                 n_requests: int = 96, max_queue: int = 4) -> dict:
+    """Open-loop arrivals at ``over_factor``x the sustainable rate
+    through a bounded-queue ``AdmissionController``: admitted p99 stays
+    bounded (~``(max_queue+1)`` service times) and the excess is shed
+    with ``RaftOverloadError`` — the no-queue-collapse acceptance."""
+    from raft_tpu import errors
+    from raft_tpu.resilience import AdmissionController
+
+    p50 = _dispatch_p50_s(run, qb)
+    interval = p50 / over_factor
+    ctrl = AdmissionController(max_concurrent=1, max_queue=max_queue)
+    inputs = [qb * (1.0 + 1e-6 * (i + 1)) for i in range(n_requests)]
+    jax.block_until_ready(inputs)
+    lock = threading.Lock()
+    ok_ms, n_shed, n_timeout = [], [0], [0]
+
+    def handle(qi):
+        t0 = time.perf_counter()
+        try:
+            # generous in-queue wait: the queue bound, not this timeout,
+            # is what sheds load
+            with ctrl.admit(timeout_s=60.0):
+                jax.block_until_ready(run(qi))
+            with lock:
+                ok_ms.append((time.perf_counter() - t0) * 1e3)
+        except errors.RaftOverloadError:
+            with lock:
+                n_shed[0] += 1
+        except errors.RaftTimeoutError:
+            with lock:
+                n_timeout[0] += 1
+
+    threads = []
+    t0 = time.perf_counter()
+    for i, qi in enumerate(inputs):
+        lag = t0 + i * interval - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        th = threading.Thread(target=handle, args=(qi,))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    st = ctrl.stats()
+    row = {
+        "engine": "ivf_flat",
+        "scenario": "overload_2x",
+        "nq": int(qb.shape[0]),
+        "p50_ms": round(p50 * 1e3, 3),
+        "offered_x": over_factor,
+        "shed_rate": round(n_shed[0] / n_requests, 3),
+        "max_queue": max_queue,
+        "n_requests": n_requests,
+        "queue_peak": st.peak_queue_depth,
+        "timed_out": n_timeout[0],
+    }
+    if ok_ms:
+        row["p99_ms"] = round(_p99(ok_ms), 3)
+    return row
 
 
 def serving_latency_rows(
@@ -33,11 +205,15 @@ def serving_latency_rows(
     n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
                                            "ivf_pq"),
     chain=(4, 32), escalate: int = 2,
+    hedged: bool = True, overload: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
-    engine cannot sink the sweep). Parameterized so tests can run a tiny
-    config on CPU; the bench defaults are the shared 500k x 96 shape."""
+    engine cannot sink the sweep), plus — when ``ivf_flat`` is swept —
+    the ``hedged_straggler`` and ``overload_2x`` resilience rows
+    (:func:`hedged_straggler_row`, :func:`overload_row`). Parameterized
+    so tests can run a tiny config on CPU; the bench defaults are the
+    shared 500k x 96 shape."""
     from bench.common import chained_dispatch_stats
     from raft_tpu.distance.distance_type import DistanceType
     from raft_tpu.random import make_blobs
@@ -141,6 +317,31 @@ def serving_latency_rows(
                 # failed point must not sink the other 8 rows
                 row["error"] = f"{type(e).__name__}: {e}"[:160]
             rows.append(row)
+
+    # resilience rows on the warmed IVF-Flat serving program: the hedged
+    # straggler tail and the 2x-overload shed behavior (module docstring)
+    if (hedged or overload) and "ivf_flat" in engines:
+        try:
+            idx = get_index("ivf_flat")
+            nq_r = min(128, max(nqs))
+            qb = qall[:nq_r]
+            qcap_r = idx.warmup(nq_r, k=k, n_probes=n_probes)
+
+            def run_r(qq, idx=idx, qcap=qcap_r):
+                return ivf_flat_search_grouped(
+                    idx, qq, k, n_probes=n_probes, qcap=qcap,
+                )
+
+            jax.block_until_ready(run_r(qb))
+            if hedged:
+                rows.append(hedged_straggler_row(run_r, qb))
+            if overload:
+                rows.append(overload_row(run_r, qb))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "ivf_flat", "scenario": "resilience",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
     return {
         "metric": f"serving_p50_{n}x{d}_k{k}_p{n_probes}",
         "unit": "ms",

@@ -28,6 +28,8 @@ from raft_tpu.comms.mnmg_ivf import (
     mnmg_ivf_pq_build_distributed,
     mnmg_ivf_pq_search,
     place_index,
+    recover_rank,
+    replicate_index,
     reshard_index,
     shard_rows,
 )
@@ -63,6 +65,8 @@ __all__ = [
     "mnmg_ivf_flat_build_distributed",
     "mnmg_ivf_flat_search",
     "place_index",
+    "recover_rank",
+    "replicate_index",
     "reshard_index",
     "shard_rows",
     "ring_knn",

@@ -51,6 +51,7 @@ from raft_tpu.resilience.degraded import (
     resolve_shard_mask,
     sanitize_query_rows,
 )
+from raft_tpu.resilience.replica import resolve_route
 from raft_tpu.spatial.ann.common import (
     CoarseIndex,
     ListStorage,
@@ -73,7 +74,8 @@ from raft_tpu.spatial.selection import merge_parts_select_k
 __all__ = [
     "MnmgIVFPQIndex", "attach_coarse_index", "expand_probe_set",
     "mnmg_ivf_pq_build", "mnmg_ivf_pq_build_distributed",
-    "mnmg_ivf_pq_search", "place_index", "reshard_index", "shard_rows",
+    "mnmg_ivf_pq_search", "place_index", "recover_rank",
+    "replicate_index", "reshard_index", "shard_rows",
 ]
 
 # query-block size of the in-program two-level probe's candidate rerank
@@ -108,6 +110,17 @@ class MnmgIVFPQIndex:
     nl_pad: int = dataclasses.field(metadata=dict(static=True))
     max_list: int = dataclasses.field(metadata=dict(static=True))
     n_rows: int = dataclasses.field(metadata=dict(static=True))
+    # R-way striped replica layout (resilience/replica.py): each rank's
+    # slab holds `replication` segments of nl_pad/replication lists —
+    # segment 0 its own primary shard, segment j the shard
+    # (rank - j*replica_offset) % P. 1 = unreplicated (the build output;
+    # replicate with place_index(..., replication=R))
+    replication: int = dataclasses.field(
+        default=1, metadata=dict(static=True)
+    )
+    replica_offset: int = dataclasses.field(
+        default=1, metadata=dict(static=True)
+    )
     # optional two-level coarse quantizer over the GLOBAL probe set
     # (attach_coarse_index); the fused search probes through it when
     # present instead of brute-scanning every centroid
@@ -118,7 +131,7 @@ class MnmgIVFPQIndex:
                refine_ratio: float = 2.0, exact_selection: bool = True,
                approx_recall_target: float = 0.95,
                donate_queries: bool = False,
-               shard_mask=None, overprobe: float = 2.0,
+               shard_mask=None, failover=None, overprobe: float = 2.0,
                merge_ways: typing.Optional[int] = None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches: one all-zeros batch runs through
@@ -132,8 +145,9 @@ class MnmgIVFPQIndex:
         dispatches — the compiled program is keyed on both. Pass
         ``shard_mask=True`` to warm the RESILIENT variant instead (the
         ``shard_mask=``/``PartialSearchResult`` program —
-        docs/robustness.md); the mask itself is a runtime input, so one
-        warm-up covers every later health state."""
+        docs/robustness.md); the mask AND the replica-failover route
+        are runtime inputs, so one warm-up covers every later health
+        and failover state."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -144,7 +158,8 @@ class MnmgIVFPQIndex:
             exact_selection=exact_selection,
             approx_recall_target=approx_recall_target,
             donate_queries=donate_queries, shard_mask=shard_mask,
-            overprobe=overprobe, merge_ways=merge_ways,
+            failover=failover, overprobe=overprobe,
+            merge_ways=merge_ways,
         )
         jax.block_until_ready(out)
         return qc
@@ -737,7 +752,8 @@ def field_sharding(comms: Comms, name: str, ndim: int):
     return NamedSharding(comms.mesh, P())
 
 
-def reshard_index(comms: Comms, index):
+def reshard_index(comms: Comms, index, *, replication: int = 1,
+                  replica_offset: typing.Optional[int] = None):
     """Re-partition a list-sharded index built for a DIFFERENT mesh size
     onto ``comms`` — the recovery path after losing (or regaining) ranks
     (docs/robustness.md): reload the checkpoint, re-shard onto whatever
@@ -751,9 +767,15 @@ def reshard_index(comms: Comms, index):
     stay coarse-stable. Quantizers, global ids, per-list contents, and
     ``max_list`` are unchanged — search results are identical to the
     original mesh's (tests/test_resilience.py asserts it). ``owner=-1``
-    probe-set extras (:func:`expand_probe_set`) stay unowned. Returns a
-    host-resident index; :func:`place_index` (which calls this
-    automatically on a size mismatch) handles device placement."""
+    probe-set extras (:func:`expand_probe_set`) stay unowned.
+
+    An R-way REPLICATED input (docs/robustness.md "Replication &
+    failover") is read through its primary copies — a reshard always
+    de-replicates first; pass ``replication=R`` (and optionally
+    ``replica_offset``) to re-replicate the fresh layout via
+    :func:`replicate_index`. Returns a host-resident index;
+    :func:`place_index` (which calls this automatically on a size or
+    replication mismatch) handles device placement."""
     Pn = comms.size
     owner = np.asarray(index.owner)
     local_id = np.asarray(index.local_id)
@@ -814,7 +836,106 @@ def reshard_index(comms: Comms, index):
     kw = dict(
         owner=new_owner, local_id=new_lid, local_cents=lcents_sh,
         sorted_ids=new_sids, list_offsets=offs_sh, list_sizes=szs_sh,
+        n_pad=n_pad, nl_pad=nl_pad, replication=1, replica_offset=1,
+    )
+    if new_codes is not None:
+        kw["codes_sorted"] = new_codes
+    if new_vecs is not None:
+        kw["vectors_sorted"] = new_vecs
+    out = dataclasses.replace(index, **kw)
+    if replication > 1:
+        out = replicate_index(out, replication, offset=replica_offset)
+    return out
+
+
+def replicate_index(index, replication: int, *,
+                    offset: typing.Optional[int] = None):
+    """R-way replicate a list-sharded index's slabs for zero-coverage-
+    loss failover (docs/robustness.md "Replication & failover").
+
+    Host-side O(R·n) slab rebuild over the STRIPED placement
+    (:class:`raft_tpu.resilience.ReplicaPlacement`): rank ``r``'s new
+    slab is the concatenation of R segments — segment 0 its own primary
+    shard's existing layout (offsets, local ids, and rows unchanged, so
+    the healthy serving program needs no routing at all), segment ``j``
+    an exact copy of rank ``(r - j*offset) % P``'s primary layout. The
+    degraded searches' ``failover=`` route then selects at RUNTIME which
+    copy serves each logical shard: with any ≤ R-1 failures per replica
+    group every list stays served by exactly one live rank, coverage
+    stays 1.0, and results are identical to the healthy mesh.
+
+    Memory cost is exactly R× the slab footprint (rows, codes, ids, per-
+    rank centroid tables — quantizers and ownership maps were already
+    replicated). The input must be unreplicated (``replication == 1``);
+    :func:`place_index(..., replication=R)` handles stripping/resharding
+    first. Works on both sharded engines (field names shared). Returns a
+    host-resident index."""
+    from raft_tpu.resilience.replica import ReplicaPlacement
+
+    errors.expects(
+        int(getattr(index, "replication", 1) or 1) == 1,
+        "replicate_index: index is already %d-way replicated — reshard "
+        "first (place_index(..., replication=R) does both)",
+        getattr(index, "replication", 1),
+    )
+    Pn = int(index.sorted_ids.shape[0])
+    placement = ReplicaPlacement.striped(Pn, replication, offset)
+    if replication == 1:
+        return dataclasses.replace(index, replication=1, replica_offset=1)
+    offs = np.asarray(index.list_offsets)
+    szs = np.asarray(index.list_sizes)
+    lcents = np.asarray(index.local_cents)
+    sids = np.asarray(index.sorted_ids)
+    codes = getattr(index, "codes_sorted", None)
+    codes = None if codes is None else np.asarray(codes)
+    vecs = (
+        None if index.vectors_sorted is None
+        else np.asarray(index.vectors_sorted)
+    )
+    nlp0 = int(index.nl_pad)
+    d = lcents.shape[2]
+    valid = offs[:, -1]                    # rows in each rank's slab
+    segs = [placement.segments(r) for r in range(Pn)]
+    n_pad = _slab_height(
+        [int(sum(valid[s] for s in segs[r])) for r in range(Pn)]
+    )
+    nl_pad = replication * nlp0
+    new_szs = np.zeros((Pn, nl_pad), np.int32)
+    new_offs = np.zeros((Pn, nl_pad + 1), np.int32)
+    new_lcents = np.zeros((Pn, nl_pad, d), lcents.dtype)
+    new_sids = np.zeros((Pn, n_pad), np.int32)
+    new_codes = (
+        None if codes is None
+        else np.zeros((Pn, n_pad + 1, codes.shape[2]), codes.dtype)
+    )
+    new_vecs = (
+        None if vecs is None
+        else np.zeros((Pn, n_pad + 1, vecs.shape[2]), vecs.dtype)
+    )
+    for r in range(Pn):
+        # list tables: R primary tables stacked — copy j of list l lands
+        # at local id j*nlp0 + local_id[l], and the cumsum over the
+        # concatenated sizes places segment j's rows right after
+        # segments 0..j-1's valid rows (each old table's sizes sum to
+        # its valid count), so whole contiguous regions copy over
+        for j, s in enumerate(segs[r]):
+            new_szs[r, j * nlp0:(j + 1) * nlp0] = szs[s]
+            new_lcents[r, j * nlp0:(j + 1) * nlp0] = lcents[s]
+        new_offs[r] = np.concatenate([[0], np.cumsum(new_szs[r])])
+        start = 0
+        for s in segs[r]:
+            n_s = int(valid[s])
+            new_sids[r, start:start + n_s] = sids[s, :n_s]
+            if new_codes is not None:
+                new_codes[r, start:start + n_s] = codes[s, :n_s]
+            if new_vecs is not None:
+                new_vecs[r, start:start + n_s] = vecs[s, :n_s]
+            start += n_s
+    kw = dict(
+        local_cents=new_lcents, sorted_ids=new_sids,
+        list_offsets=new_offs, list_sizes=new_szs,
         n_pad=n_pad, nl_pad=nl_pad,
+        replication=replication, replica_offset=placement.offset,
     )
     if new_codes is not None:
         kw["codes_sorted"] = new_codes
@@ -823,7 +944,9 @@ def reshard_index(comms: Comms, index):
     return dataclasses.replace(index, **kw)
 
 
-def place_index(comms: Comms, index):
+def place_index(comms: Comms, index, *,
+                replication: typing.Optional[int] = None,
+                replica_offset: typing.Optional[int] = None):
     """(Re-)place a sharded index's arrays onto a comms mesh: slabs shard
     over the mesh axis, quantizers and ownership maps replicate. Works on
     any sharded index dataclass (MnmgIVFPQIndex, MnmgIVFFlatIndex); used
@@ -831,10 +954,36 @@ def place_index(comms: Comms, index):
     :func:`raft_tpu.spatial.ann.load_index`. An index built for a
     DIFFERENT mesh size is re-partitioned first via
     :func:`reshard_index` — the recovery path after losing a rank
-    (docs/robustness.md)."""
+    (docs/robustness.md).
+
+    ``replication=R`` builds (or rebuilds) the R-way striped replica
+    layout (:func:`replicate_index`) so the degraded searches can fail
+    over a dead rank's lists onto a live replica with zero coverage
+    loss (docs/robustness.md "Replication & failover"); ``None``
+    preserves the index's current replication across the placement.
+    ``replica_offset`` overrides the stripe offset (default
+    ``max(1, P // R)``)."""
     n_ranks = index.sorted_ids.shape[0]
-    if n_ranks != comms.size:
-        index = reshard_index(comms, index)
+    cur_r = int(getattr(index, "replication", 1) or 1)
+    cur_off = int(getattr(index, "replica_offset", 1) or 1)
+    want_r = cur_r if replication is None else int(replication)
+    if (
+        n_ranks != comms.size
+        or want_r != cur_r
+        or (replica_offset is not None and want_r > 1
+            and int(replica_offset) != cur_off)
+    ):
+        if n_ranks == comms.size and cur_r == 1:
+            # same mesh, unreplicated input: the layout is already what
+            # replicate_index consumes — skip the O(n) reshard pass
+            index = replicate_index(
+                index, want_r, offset=replica_offset
+            )
+        else:
+            index = reshard_index(
+                comms, index, replication=want_r,
+                replica_offset=replica_offset,
+            )
     kw = {}
     for f in dataclasses.fields(type(index)):
         v = getattr(index, f.name)
@@ -850,6 +999,81 @@ def place_index(comms: Comms, index):
                 )
         kw[f.name] = v
     return type(index)(**kw)
+
+
+def recover_rank(comms: Comms, index, path, rank: int):
+    """Online re-placement of ONE rank's slab content from a saved
+    checkpoint — the spare/healed-rank recovery path (docs/robustness.md
+    "Replication & failover"): after :class:`~raft_tpu.resilience.FailoverPlan`
+    routed a dead rank's shards onto replicas, a replacement chip joins,
+    its lost slabs are restored from the v2+/v3 checkpoint (CRC-verified
+    by :func:`raft_tpu.spatial.ann.load_index`), health flips up, and
+    the route flips back to primaries — no k-means, no re-encode, no
+    row exchange, no full-index re-placement.
+
+    The checkpoint must carry the SAME layout as the live index (mesh
+    size, slab heights, replication geometry, ownership maps) — i.e. a
+    checkpoint of this very build; a layout mismatch raises rather than
+    splicing rows into the wrong slots (restore onto a different mesh
+    goes through ``load_index(comms=)``/:func:`place_index` instead).
+    Only ``rank``'s rows of the sharded slab fields are spliced in; the
+    update is a functional ``.at[rank].set`` re-placed onto the mesh
+    sharding. Returns the recovered index."""
+    from raft_tpu.spatial.ann.serialize import load_index
+
+    errors.expects(
+        0 <= rank < comms.size,
+        "recover_rank: rank %d out of range [0, %d)", rank, comms.size,
+    )
+    host = load_index(path)
+    errors.expects(
+        type(host) is type(index),
+        "recover_rank: checkpoint holds a %s, live index is a %s",
+        type(host).__name__, type(index).__name__,
+    )
+    for name in ("n_pad", "nl_pad", "max_list", "n_rows",
+                 "replication", "replica_offset"):
+        errors.expects(
+            getattr(host, name, None) == getattr(index, name, None),
+            "recover_rank: checkpoint %s=%r != live index %s=%r — not a "
+            "checkpoint of this build (restore via load_index/place_index)",
+            name, getattr(host, name, None), name,
+            getattr(index, name, None),
+        )
+    errors.expects(
+        host.sorted_ids.shape[0] == comms.size
+        and index.sorted_ids.shape[0] == comms.size,
+        "recover_rank: rank counts differ (checkpoint %d, index %d, "
+        "mesh %d)", host.sorted_ids.shape[0], index.sorted_ids.shape[0],
+        comms.size,
+    )
+    errors.expects(
+        np.array_equal(np.asarray(host.owner), np.asarray(index.owner)),
+        "recover_rank: checkpoint ownership map differs from the live "
+        "index — its slab rows would splice into the wrong lists",
+    )
+    kw = {}
+    for f in dataclasses.fields(type(index)):
+        if f.name not in _SHARDED_FIELDS:
+            continue
+        cur = getattr(index, f.name)
+        src = getattr(host, f.name)
+        if cur is None and src is None:
+            continue
+        errors.expects(
+            cur is not None and src is not None
+            and tuple(np.shape(src)) == tuple(np.shape(cur)),
+            "recover_rank: field %r shape mismatch (checkpoint %s, live "
+            "%s)", f.name,
+            None if src is None else tuple(np.shape(src)),
+            None if cur is None else tuple(np.shape(cur)),
+        )
+        row = jnp.asarray(np.asarray(src)[rank])
+        updated = jnp.asarray(cur).at[rank].set(row)
+        kw[f.name] = jax.device_put(
+            updated, field_sharding(comms, f.name, updated.ndim)
+        )
+    return dataclasses.replace(index, **kw)
 
 
 @functools.lru_cache(maxsize=32)
@@ -868,14 +1092,20 @@ def _cached_search(
     dispatch: the output may alias the input's memory and no copy of the
     batch survives the call — the caller must not reuse the array).
 
-    ``degraded=True`` compiles the resilient serving variant: an extra
-    ``alive`` (P,) int32 RUNTIME input (so health flips never recompile)
-    masks a down shard's contribution to +inf before the merge,
-    non-finite query rows are neutralized in-graph, and the program
-    returns ``(dists, ids, coverage, row_valid)``
+    ``degraded=True`` compiles the resilient serving variant: TWO extra
+    (P,) int32 RUNTIME inputs (so health AND failover flips never
+    recompile) — ``alive`` masks a down shard's contribution to +inf
+    before the merge, and ``route`` selects which replica copy serves
+    each logical shard (all zeros = primaries; with an R-way replicated
+    index a :class:`~raft_tpu.resilience.FailoverPlan` routes a dead
+    rank's shards onto live replica segments with zero coverage loss —
+    docs/robustness.md "Replication & failover"). Non-finite query rows
+    are neutralized in-graph, and the program returns
+    ``(dists, ids, coverage, row_valid)``
     (raft_tpu.resilience.degraded; docs/robustness.md).
 
-    The last three statics select the probe and merge widths:
+    The ``use_coarse``/``overprobe``/``merge_ways`` statics select the
+    probe and merge widths:
     ``use_coarse``/``overprobe`` engage the fused two-level coarse probe
     (three extra replicated CoarseIndex array inputs), and ``merge_ways``
     pads the allgathered per-shard payloads with +inf/-1 entries up to a
@@ -885,18 +1115,20 @@ def _cached_search(
     like owner=-1 lists)."""
     (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
      approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list,
-     use_coarse, overprobe, merge_ways) = statics
+     use_coarse, overprobe, merge_ways, replication,
+     replica_offset) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
+    n_ranks = comms.size
 
     def body(*opnds):
         if degraded:
             (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
-             loffs, lszs, q, sup_c, mem_i, cpad, alive) = opnds
+             loffs, lszs, q, sup_c, mem_i, cpad, alive, route) = opnds
         else:
             (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
              loffs, lszs, q, sup_c, mem_i, cpad) = opnds
-            alive = None
+            alive = route = None
         # sharded slabs arrive as (1, ...) blocks — drop the mesh axis
         lcents, codes_s, sids = lcents[0], codes_s[0], sids[0]
         loffs, lszs = loffs[0], lszs[0]
@@ -918,10 +1150,34 @@ def _cached_search(
         else:
             probes_g, _ = coarse_probe(qf, cents, n_probes)  # (nq, p)
         probe_owner = owner[probes_g]                        # (nq, p)
-        own = probe_owner == rank
-        lp = jnp.where(
-            own, local_id[probes_g], jnp.int32(nl_pad - 1)   # sentinel list
-        )
+        if degraded:
+            # replica-aware routing: route[s] (runtime, like alive)
+            # names the copy index serving logical shard s, so the rank
+            # holding that copy serves the probe from its slab segment
+            # j (local id j*nlp_base + primary local id). All-zeros
+            # route == primaries == the unrouted serve rule; failover
+            # flips change VALUES only — never the program.
+            j = route[jnp.clip(probe_owner, 0, n_ranks - 1)]
+            serving = jnp.where(
+                (probe_owner >= 0) & (j >= 0),
+                (probe_owner + jnp.maximum(j, 0) * replica_offset)
+                % n_ranks,
+                -1,
+            )                                # (nq, p) serving rank | -1
+            own = serving == rank
+            nlp_base = nl_pad // replication
+            lp = jnp.where(
+                own,
+                jnp.maximum(j, 0) * nlp_base + local_id[probes_g],
+                jnp.int32(nl_pad - 1),                       # sentinel
+            )
+        else:
+            serving = probe_owner
+            own = probe_owner == rank
+            lp = jnp.where(
+                own, local_id[probes_g],
+                jnp.int32(nl_pad - 1),                       # sentinel
+            )
 
         storage = ListStorage(
             sorted_ids=sids,
@@ -956,7 +1212,10 @@ def _cached_search(
         md, mi = merge_parts_select_k(pd, pi, k, ways=merge_ways)
         mi = jnp.where(jnp.isfinite(md), mi, -1)
         if degraded:
-            cov = probe_coverage(probe_owner, alive, row_valid)
+            # coverage counts a probe served iff SOME live rank serves
+            # it under the route — a failed-over shard on a live
+            # replica counts covered (coverage 1.0, zero loss)
+            cov = probe_coverage(serving, alive, row_valid)
             md, mi = mask_invalid_rows(md, mi, row_valid)
             return md, mi, cov, row_valid
         return md, mi
@@ -974,12 +1233,13 @@ def _cached_search(
     )
     out_specs = (rep2, rep2)
     if degraded:
-        in_specs = in_specs + (P(None),)
+        in_specs = in_specs + (P(None), P(None))     # alive, route
         out_specs = (rep2, rep2, P(None), P(None))
     sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
     # queries are positional argument 10 (the coarse arrays and, when
-    # present, the alive mask follow them); donation frees/aliases the
-    # batch buffer for the outputs (index slabs are never donated)
+    # present, the alive mask + failover route follow them); donation
+    # frees/aliases the batch buffer for the outputs (index slabs are
+    # never donated)
     return jax.jit(sm, donate_argnums=(10,) if donate else ())
 
 
@@ -1106,6 +1366,7 @@ def mnmg_ivf_pq_search(
     qcap_max_drop_frac: typing.Optional[float] = None,
     donate_queries: bool = False,
     shard_mask=None,
+    failover=None,
     overprobe: float = 2.0,
     merge_ways: typing.Optional[int] = None,
 ):
@@ -1150,6 +1411,18 @@ def mnmg_ivf_pq_search(
     per-query ``coverage`` and the ``partial`` flag. The mask is a
     runtime input: flipping a rank's health never recompiles.
 
+    ``failover`` (requires ``shard_mask``) routes logical shards onto
+    replica copies at RUNTIME: pass a
+    :class:`raft_tpu.resilience.FailoverPlan` (or a ``(P,)`` copy-index
+    array) built from the same health state, and — on an R-way
+    replicated index (``place_index(..., replication=R)``) — any ≤ R-1
+    failures per replica group serve every list from a live replica
+    segment: ``coverage`` stays 1.0 and results are identical to the
+    healthy mesh. Like the mask, the route is a runtime input — failover
+    flips never recompile (docs/robustness.md "Replication & failover").
+    Note a failover rank scans up to R shards' worth of non-empty lists;
+    its latency grows accordingly (the hedging rationale).
+
     ``overprobe`` (static) widens the two-level coarse probe's super
     scan when the index carries a coarse quantizer
     (:func:`attach_coarse_index`; ignored otherwise). ``merge_ways``
@@ -1184,8 +1457,14 @@ def mnmg_ivf_pq_search(
         index.nl_pad, index.max_list,
         index.coarse is not None, float(overprobe),
         None if merge_ways is None else int(merge_ways),
+        int(index.replication), int(index.replica_offset),
     )
     degraded = shard_mask is not None
+    errors.expects(
+        failover is None or degraded,
+        "failover= requires shard_mask= (the resilient serving variant "
+        "carries the routing input)",
+    )
     fn = _cached_search(
         comms.mesh, comms.axis, store_raw, statics, donate_queries,
         degraded,
@@ -1205,7 +1484,11 @@ def mnmg_ivf_pq_search(
     if not degraded:
         return fn(*args)
     alive = resolve_shard_mask(shard_mask, comms.size)
-    md, mi, cov, rv = fn(*args, jnp.asarray(alive))
+    route = resolve_route(
+        failover, comms.size, int(index.replication),
+        int(index.replica_offset),
+    )
+    md, mi, cov, rv = fn(*args, jnp.asarray(alive), jnp.asarray(route))
     return PartialSearchResult(
         distances=md, ids=mi, coverage=cov, row_valid=rv
     )

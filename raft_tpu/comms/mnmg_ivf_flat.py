@@ -50,6 +50,7 @@ from raft_tpu.resilience.degraded import (
     resolve_shard_mask,
     sanitize_query_rows,
 )
+from raft_tpu.resilience.replica import resolve_route
 from raft_tpu.comms.mnmg_ivf import (
     _cached_program,
     _cdiv_host,
@@ -104,6 +105,14 @@ class MnmgIVFFlatIndex:
     max_list: int = dataclasses.field(metadata=dict(static=True))
     n_rows: int = dataclasses.field(metadata=dict(static=True))
     metric: str = dataclasses.field(metadata=dict(static=True))
+    # R-way striped replica layout — see MnmgIVFPQIndex (field names and
+    # semantics shared; replicate with place_index(..., replication=R))
+    replication: int = dataclasses.field(
+        default=1, metadata=dict(static=True)
+    )
+    replica_offset: int = dataclasses.field(
+        default=1, metadata=dict(static=True)
+    )
     # optional two-level coarse quantizer over the GLOBAL probe set
     # (raft_tpu.comms.mnmg_ivf.attach_coarse_index)
     coarse: typing.Optional[CoarseIndex] = None
@@ -111,7 +120,7 @@ class MnmgIVFFlatIndex:
     def warmup(self, comms: "Comms", nq: int, *, k: int = 10,
                n_probes: int = 8, qcap=None, list_block: int = 32,
                donate_queries: bool = False, shard_mask=None,
-               overprobe: float = 2.0,
+               failover=None, overprobe: float = 2.0,
                merge_ways: typing.Optional[int] = None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through
@@ -121,8 +130,9 @@ class MnmgIVFFlatIndex:
         Returns the shape-only-resolved qcap; pass exactly that integer
         (and the same ``donate_queries``) on serving dispatches. Pass
         ``shard_mask=True`` to warm the resilient variant instead
-        (docs/robustness.md); the mask is a runtime input, so one
-        warm-up covers every later health state."""
+        (docs/robustness.md); the mask and the replica-failover route
+        are runtime inputs, so one warm-up covers every later health
+        and failover state."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -130,8 +140,8 @@ class MnmgIVFFlatIndex:
         out = mnmg_ivf_flat_search(
             comms, self, q0, k, n_probes=n_probes, qcap=qc,
             list_block=list_block, donate_queries=donate_queries,
-            shard_mask=shard_mask, overprobe=overprobe,
-            merge_ways=merge_ways,
+            shard_mask=shard_mask, failover=failover,
+            overprobe=overprobe, merge_ways=merge_ways,
         )
         jax.block_until_ready(out)
         return qc
@@ -273,25 +283,29 @@ def _cached_search(
     keyed on value-hashable (mesh, axis), not the Comms identity.
     ``donate=True`` donates the query buffer (serving dispatch; the
     caller must not reuse the array after the call). ``degraded=True``
-    compiles the resilient variant — an ``alive`` (P,) runtime mask,
-    +inf contributions from down shards, in-graph query sanitization,
-    and (dists, ids, coverage, row_valid) outputs (docs/robustness.md).
-    The last three statics select the probe/merge widths exactly as in
+    compiles the resilient variant — ``alive`` AND ``route`` (P,)
+    runtime inputs (health mask + replica-failover copy selection,
+    exactly as in the PQ engine), +inf contributions from down shards,
+    in-graph query sanitization, and (dists, ids, coverage, row_valid)
+    outputs (docs/robustness.md). The ``use_coarse``/``overprobe``/
+    ``merge_ways`` statics select the probe/merge widths exactly as in
     the PQ engine's ``_cached_search`` (two-level coarse probe +
     deployment-width in-program merge)."""
     (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list,
-     use_coarse, overprobe, merge_ways) = statics
+     use_coarse, overprobe, merge_ways, replication,
+     replica_offset) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
+    n_ranks = comms.size
 
     def body(*opnds):
         if degraded:
             (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
-             q, sup_c, mem_i, cpad, alive) = opnds
+             q, sup_c, mem_i, cpad, alive, route) = opnds
         else:
             (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
              q, sup_c, mem_i, cpad) = opnds
-            alive = None
+            alive = route = None
         lcents, vecs, sids = lcents[0], vecs_s[0], sids[0]
         loffs, lszs = loffs[0], lszs[0]
         rank = lax.axis_index(ax.axis)
@@ -310,10 +324,31 @@ def _cached_search(
         else:
             probes_g, _ = coarse_probe(qf, cents, n_probes)  # (nq, p)
         probe_owner = owner[probes_g]                        # (nq, p)
-        own = probe_owner == rank
-        lp = jnp.where(
-            own, local_id[probes_g], jnp.int32(nl_pad - 1)   # sentinel list
-        )
+        if degraded:
+            # replica-aware routing (see the PQ engine body): route[s]
+            # selects the copy serving shard s — a runtime input, so
+            # failover flips never retrace
+            j = route[jnp.clip(probe_owner, 0, n_ranks - 1)]
+            serving = jnp.where(
+                (probe_owner >= 0) & (j >= 0),
+                (probe_owner + jnp.maximum(j, 0) * replica_offset)
+                % n_ranks,
+                -1,
+            )                                # (nq, p) serving rank | -1
+            own = serving == rank
+            nlp_base = nl_pad // replication
+            lp = jnp.where(
+                own,
+                jnp.maximum(j, 0) * nlp_base + local_id[probes_g],
+                jnp.int32(nl_pad - 1),                       # sentinel
+            )
+        else:
+            serving = probe_owner
+            own = probe_owner == rank
+            lp = jnp.where(
+                own, local_id[probes_g],
+                jnp.int32(nl_pad - 1),                       # sentinel
+            )
 
         storage = ListStorage(
             sorted_ids=sids,
@@ -342,7 +377,8 @@ def _cached_search(
         md, mi = merge_parts_select_k(pd, pi, k, ways=merge_ways)
         mi = jnp.where(jnp.isfinite(md), mi, -1)
         if degraded:
-            cov = probe_coverage(probe_owner, alive, row_valid)
+            # a failed-over shard on a live replica counts covered
+            cov = probe_coverage(serving, alive, row_valid)
             md, mi = mask_invalid_rows(md, mi, row_valid)
             return md, mi, cov, row_valid
         return md, mi
@@ -358,11 +394,12 @@ def _cached_search(
     )
     out_specs = (rep2, rep2)
     if degraded:
-        in_specs = in_specs + (P(None),)
+        in_specs = in_specs + (P(None), P(None))     # alive, route
         out_specs = (rep2, rep2, P(None), P(None))
     sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
     # queries are positional argument 8; the coarse arrays and, when
-    # present, the alive mask follow them (donation: serving mode)
+    # present, the alive mask + failover route follow them (donation:
+    # serving mode)
     return jax.jit(sm, donate_argnums=(8,) if donate else ())
 
 
@@ -373,6 +410,7 @@ def mnmg_ivf_flat_search(
     qcap_max_drop_frac: typing.Optional[float] = None,
     donate_queries: bool = False,
     shard_mask=None,
+    failover=None,
     overprobe: float = 2.0,
     merge_ways: typing.Optional[int] = None,
 ):
@@ -402,6 +440,13 @@ def mnmg_ivf_flat_search(
     the return type becomes
     :class:`raft_tpu.resilience.PartialSearchResult` with per-query
     ``coverage`` and the ``partial`` flag (docs/robustness.md).
+
+    ``failover`` (requires ``shard_mask``) as in the PQ engine: a
+    :class:`raft_tpu.resilience.FailoverPlan` (or ``(P,)`` copy-index
+    array) routing each logical shard onto a replica copy at runtime —
+    on an R-way replicated index, ≤ R-1 failures per replica group keep
+    ``coverage`` at 1.0 with results identical to the healthy mesh,
+    and flips never recompile.
 
     ``overprobe``/``merge_ways`` (both static) as in the PQ engine: the
     two-level coarse probe's super-scan width when the index carries a
@@ -436,8 +481,14 @@ def mnmg_ivf_flat_search(
         index.max_list,
         index.coarse is not None, float(overprobe),
         None if merge_ways is None else int(merge_ways),
+        int(index.replication), int(index.replica_offset),
     )
     degraded = shard_mask is not None
+    errors.expects(
+        failover is None or degraded,
+        "failover= requires shard_mask= (the resilient serving variant "
+        "carries the routing input)",
+    )
     fn = _cached_search(
         comms.mesh, comms.axis, statics, donate_queries, degraded
     )
@@ -455,7 +506,11 @@ def mnmg_ivf_flat_search(
             vals = jnp.sqrt(jnp.maximum(vals, 0.0))
         return vals, ids
     alive = resolve_shard_mask(shard_mask, comms.size)
-    md, mi, cov, rv = fn(*args, jnp.asarray(alive))
+    route = resolve_route(
+        failover, comms.size, int(index.replication),
+        int(index.replica_offset),
+    )
+    md, mi, cov, rv = fn(*args, jnp.asarray(alive), jnp.asarray(route))
     if index.metric == "l2":
         # sqrt after the merge, exactly as the healthy path; +inf slots
         # (down shards, invalid rows) stay +inf

@@ -23,7 +23,7 @@ Design notes (Python/JAX, not a translation):
 
 from __future__ import annotations
 
-import inspect
+import sys
 from typing import Any
 
 import numpy as np
@@ -32,6 +32,7 @@ __all__ = [
     "RaftException",
     "RaftLogicError",
     "RaftTimeoutError",
+    "RaftOverloadError",
     "CorruptIndexError",
     "expects",
     "fail",
@@ -48,10 +49,16 @@ class RaftException(RuntimeError):
     collected backtrace."""
 
     def __init__(self, msg: str, *, _stacklevel: int = 1):
-        frame = inspect.stack()[_stacklevel]
-        super().__init__(
-            f"RAFT failure at {frame.filename}:{frame.lineno}: {msg}"
-        )
+        # sys._getframe, not inspect.stack(): the latter materializes
+        # (and reads source context for) EVERY frame — ~100s of ms on a
+        # cold linecache, paid per raise. Timeouts/hedges/sheds raise on
+        # the serving hot path, so frame capture must be O(1).
+        try:
+            frame = sys._getframe(_stacklevel)
+            where = f"{frame.f_code.co_filename}:{frame.f_lineno}"
+        except ValueError:  # stack shallower than _stacklevel
+            where = "<unknown>"
+        super().__init__(f"RAFT failure at {where}: {msg}")
 
 
 class RaftLogicError(RaftException, ValueError):
@@ -69,6 +76,28 @@ class RaftTimeoutError(RaftException, TimeoutError):
     failure, not a bad argument, so existing ``except ValueError``
     handlers never swallow it. Subclasses the builtin ``TimeoutError``
     so generic deadline plumbing (``except TimeoutError``) also works."""
+
+
+class RaftOverloadError(RaftException):
+    """Admission control shed this request: the serving queue is at its
+    configured depth bound (or the token limiter is empty), so accepting
+    the request would grow latency without bound instead of answering
+    anyone on time (``raft_tpu.resilience.admission``; docs/serving.md
+    "Overload and shedding").
+
+    Deliberately NOT a :class:`ValueError` (see
+    :class:`RaftTimeoutError`): overload is an operational condition the
+    CLIENT must back off from, not a malformed argument, so existing
+    ``except ValueError`` bad-request handlers never absorb it.
+
+    ``retry_after_s``: the server's suggested client backoff (None when
+    it has no estimate) — the HTTP ``Retry-After`` analog.
+    """
+
+    def __init__(self, msg: str, *, retry_after_s: "float | None" = None,
+                 _stacklevel: int = 1):
+        super().__init__(msg, _stacklevel=_stacklevel + 1)
+        self.retry_after_s = retry_after_s
 
 
 class CorruptIndexError(RaftException):

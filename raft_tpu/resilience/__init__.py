@@ -4,27 +4,45 @@ The reference builds robustness into its primitives
 (``raft::interruptible`` cancellable stream waits, NCCL async-error
 polling in ``sync_stream``, communicator round-trip self-tests); at the
 ROADMAP's serving scale (heavy traffic, millions of users) preemption,
-slow chips, dead shards, corrupt checkpoints, and poisoned inputs are
-ROUTINE, so every failure mode needs a bounded, classified, testable
-answer (docs/robustness.md):
+slow chips, dead shards, corrupt checkpoints, poisoned inputs, and
+overload are ROUTINE, so every failure mode needs a bounded,
+classified, testable answer (docs/robustness.md):
 
 * deadlines + retries: :class:`Deadline`, :class:`RetryPolicy`,
   :func:`dispatch_with_deadline` — bounded waits over
   ``Interruptible.synchronize(timeout_s=)``; retries re-dispatch the
   already-compiled program;
+* tail-latency hedging: :class:`HedgePolicy`, :func:`dispatch_hedged`
+  — a backup dispatch after a percentile-derived delay, first ready
+  answer wins, loser abandoned (cooperative);
 * shard health: :class:`ShardHealth` (the per-rank validity mask the
-  degraded sharded searches consume), :func:`health_check` (the
-  communicator self-test sweep with per-collective timings);
+  degraded sharded searches consume; ``apply_report`` folds a
+  :func:`health_check` sweep straight into it), :func:`health_check`
+  (the communicator self-test sweep with per-collective timings);
+* replication + failover: :class:`ReplicaPlacement`,
+  :class:`FailoverPlan` — R-way striped shard replicas
+  (``place_index(..., replication=R)``) and the runtime route that
+  serves a dead rank's lists from a live replica with ZERO coverage
+  loss;
 * degraded results: :class:`PartialSearchResult` — the
   ``coverage``/``partial`` contract returned by the sharded searches
   under ``shard_mask=``;
+* admission control: :class:`AdmissionController` — bounded queue +
+  concurrency + token limiter, shedding with
+  :class:`raft_tpu.errors.RaftOverloadError` instead of collapsing;
 * fault injection lives in :mod:`raft_tpu.testing.faults` so the chaos
   suite (tests/test_resilience.py) proves each behavior on CPU in CI.
 """
 
+from raft_tpu.resilience.admission import (
+    AdmissionController,
+    AdmissionStats,
+)
 from raft_tpu.resilience.deadline import (
     Deadline,
+    HedgePolicy,
     RetryPolicy,
+    dispatch_hedged,
     dispatch_with_deadline,
 )
 from raft_tpu.resilience.degraded import (
@@ -37,10 +55,19 @@ from raft_tpu.resilience.health import (
     ShardHealth,
     health_check,
 )
+from raft_tpu.resilience.replica import (
+    FailoverPlan,
+    ReplicaPlacement,
+    resolve_route,
+)
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionStats",
     "Deadline",
+    "HedgePolicy",
     "RetryPolicy",
+    "dispatch_hedged",
     "dispatch_with_deadline",
     "PartialSearchResult",
     "resolve_shard_mask",
@@ -48,4 +75,7 @@ __all__ = [
     "HealthProbe",
     "HealthReport",
     "health_check",
+    "FailoverPlan",
+    "ReplicaPlacement",
+    "resolve_route",
 ]

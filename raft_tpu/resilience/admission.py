@@ -1,0 +1,267 @@
+"""Admission control for the serving path — shed early, never collapse.
+
+An overloaded queue is the quiet killer of tail latency: past the
+sustainable rate, every admitted request makes EVERY later request
+slower, latency grows without bound, and by the time clients time out
+the server has burned its capacity computing answers nobody is waiting
+for. The cure is ancient and boring: bound the queue, and REJECT at the
+door once it is full — a shed request costs microseconds and tells the
+client exactly when to retry, while an admitted-then-late request costs
+a full dispatch and tells nobody anything (docs/serving.md "Overload
+and shedding").
+
+:class:`AdmissionController` is that door for the jitted serving
+dispatches:
+
+* a **concurrency bound** (``max_concurrent``) — how many dispatches
+  may be in flight at once (usually 1 per mesh: the device serializes
+  them anyway, and queueing host-side keeps the deadline machinery in
+  charge);
+* a **queue bound** (``max_queue``) — how many requests may WAIT for a
+  slot; arrivals beyond it are shed immediately with
+  :class:`raft_tpu.errors.RaftOverloadError` carrying ``retry_after_s``
+  (estimated from the measured service time — the queue ahead of the
+  client, priced);
+* an optional **token limiter** (``rate`` tokens/s, ``burst`` bucket
+  depth) — an absolute request-rate ceiling independent of measured
+  service time, for capping a tenant or protecting a cold cache;
+* **counters** (:meth:`AdmissionController.stats`): admitted / shed /
+  completed / queue depth / peak depth — the shed-rate observability
+  the overload bench row reports.
+
+Everything is host-side and thread-safe; the injected ``clock`` makes
+the token limiter deterministic under test. Timeouts while QUEUED raise
+:class:`raft_tpu.errors.RaftTimeoutError` (the caller's deadline
+expired — same classification as a slow dispatch), never an overload.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import threading
+import time
+from typing import Callable, Optional
+
+from raft_tpu import errors
+from raft_tpu.resilience.deadline import Deadline
+
+__all__ = ["AdmissionController", "AdmissionStats"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionStats:
+    """A point-in-time snapshot of an :class:`AdmissionController`'s
+    counters (all monotonic except the two depth gauges)."""
+
+    admitted: int
+    completed: int
+    shed_queue: int
+    shed_rate: int
+    timed_out: int
+    in_flight: int
+    queue_depth: int
+    peak_queue_depth: int
+
+    @property
+    def shed(self) -> int:
+        """Total requests rejected at the door (queue + rate)."""
+        return self.shed_queue + self.shed_rate
+
+    @property
+    def offered(self) -> int:
+        """Total requests that reached the controller."""
+        return self.admitted + self.shed + self.timed_out
+
+    @property
+    def shed_fraction(self) -> float:
+        off = self.offered
+        return self.shed / off if off else 0.0
+
+
+class AdmissionController:
+    """Bounded-depth admission for serving dispatches (thread-safe).
+
+    ``with ctrl.admit(timeout_s=...):`` brackets one request: it either
+    acquires an in-flight slot (waiting in the bounded queue if
+    necessary), sheds immediately with
+    :class:`~raft_tpu.errors.RaftOverloadError` (queue full or token
+    limiter empty), or raises
+    :class:`~raft_tpu.errors.RaftTimeoutError` when the caller's wait
+    budget expires while queued. The body runs the dispatch; slot
+    release and the service-time EWMA (which prices ``retry_after_s``
+    for later sheds) happen on exit, success or failure.
+
+    ``retry_after_s``: fallback retry-after for sheds before any
+    service time has been measured (None = omit the estimate).
+    ``clock``: monotonic-seconds source, injectable for deterministic
+    token-limiter tests.
+    """
+
+    def __init__(self, *, max_concurrent: int = 1, max_queue: int = 0,
+                 rate: Optional[float] = None,
+                 burst: Optional[int] = None,
+                 retry_after_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        errors.expects(
+            max_concurrent >= 1,
+            "AdmissionController: max_concurrent=%d < 1", max_concurrent,
+        )
+        errors.expects(
+            max_queue >= 0,
+            "AdmissionController: max_queue=%d < 0", max_queue,
+        )
+        errors.expects(
+            rate is None or rate > 0,
+            "AdmissionController: rate=%s must be > 0 (or None)", rate,
+        )
+        self.max_concurrent = int(max_concurrent)
+        self.max_queue = int(max_queue)
+        self.rate = None if rate is None else float(rate)
+        self.burst = (
+            None if self.rate is None
+            else max(1, int(burst if burst is not None else 1))
+        )
+        self.retry_after_s = retry_after_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._slot_free = threading.Condition(self._lock)
+        self._in_flight = 0
+        self._queue_depth = 0
+        self._peak_queue = 0
+        self._admitted = 0
+        self._completed = 0
+        self._shed_queue = 0
+        self._shed_rate = 0
+        self._timed_out = 0
+        self._service_ewma_s: Optional[float] = None
+        # token bucket state (continuous refill at `rate`/s up to burst)
+        self._tokens = float(self.burst or 0)
+        self._token_stamp = clock()
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> AdmissionStats:
+        with self._lock:
+            return AdmissionStats(
+                admitted=self._admitted,
+                completed=self._completed,
+                shed_queue=self._shed_queue,
+                shed_rate=self._shed_rate,
+                timed_out=self._timed_out,
+                in_flight=self._in_flight,
+                queue_depth=self._queue_depth,
+                peak_queue_depth=self._peak_queue,
+            )
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queue_depth
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    def _retry_after(self, waiters: int) -> Optional[float]:
+        """Price the queue ahead of a shed client: (queued + in-flight)
+        service times at the measured EWMA; the configured fallback
+        before any completion has been measured."""
+        if self._service_ewma_s is None:
+            return self.retry_after_s
+        return (waiters + self._in_flight) * self._service_ewma_s
+
+    def _refill_tokens(self, now: float) -> None:
+        self._tokens = min(
+            float(self.burst),
+            self._tokens + (now - self._token_stamp) * self.rate,
+        )
+        self._token_stamp = now
+
+    # -- the admission gate --------------------------------------------------
+    @contextlib.contextmanager
+    def admit(self, *, timeout_s: Optional[float] = None,
+              deadline: Optional[Deadline] = None):
+        """Admit one request (context manager). Sheds with
+        :class:`RaftOverloadError` when the queue is at ``max_queue`` or
+        the token limiter is empty; raises :class:`RaftTimeoutError` if
+        no in-flight slot frees within ``timeout_s`` /
+        ``deadline.remaining()`` (the tighter) while queued."""
+        if deadline is not None:
+            rem = deadline.remaining()
+            timeout_s = rem if timeout_s is None else min(timeout_s, rem)
+        if timeout_s is not None and not math.isfinite(timeout_s):
+            # an unbounded Deadline (remaining() = +inf) means wait
+            # forever — Condition.wait(inf) would OverflowError
+            timeout_s = None
+        with self._lock:
+            # queue bound first (stateless check), then the token bucket
+            # (which consumes): a queue-shed request must not burn a token
+            if (
+                self._in_flight >= self.max_concurrent
+                and self._queue_depth >= self.max_queue
+            ):
+                self._shed_queue += 1
+                raise errors.RaftOverloadError(
+                    f"admission queue full ({self._queue_depth} waiting, "
+                    f"{self._in_flight} in flight; max_queue="
+                    f"{self.max_queue})",
+                    retry_after_s=self._retry_after(self._queue_depth),
+                )
+            if self.rate is not None:
+                self._refill_tokens(self._clock())
+                if self._tokens < 1.0:
+                    self._shed_rate += 1
+                    raise errors.RaftOverloadError(
+                        f"rate limit exhausted ({self.rate}/s, burst "
+                        f"{self.burst})",
+                        retry_after_s=(1.0 - self._tokens) / self.rate,
+                    )
+                self._tokens -= 1.0
+            self._queue_depth += 1
+            self._peak_queue = max(self._peak_queue, self._queue_depth)
+            wait_until = (
+                None if timeout_s is None
+                else time.monotonic() + timeout_s
+            )
+            try:
+                while self._in_flight >= self.max_concurrent:
+                    wait = (
+                        None if wait_until is None
+                        else wait_until - time.monotonic()
+                    )
+                    if wait is not None and wait <= 0:
+                        self._timed_out += 1
+                        raise errors.RaftTimeoutError(
+                            "admission wait expired after "
+                            f"{timeout_s:.3g}s ({self._queue_depth - 1} "
+                            "still queued ahead)"
+                        )
+                    self._slot_free.wait(wait)
+            finally:
+                self._queue_depth -= 1
+            self._in_flight += 1
+            self._admitted += 1
+        t0 = time.monotonic()
+        try:
+            yield self
+        finally:
+            held = time.monotonic() - t0
+            with self._lock:
+                self._in_flight -= 1
+                self._completed += 1
+                self._service_ewma_s = (
+                    held if self._service_ewma_s is None
+                    else 0.8 * self._service_ewma_s + 0.2 * held
+                )
+                self._slot_free.notify()
+
+    def __repr__(self) -> str:
+        s = self.stats()
+        return (
+            f"AdmissionController(max_concurrent={self.max_concurrent}, "
+            f"max_queue={self.max_queue}, in_flight={s.in_flight}, "
+            f"queued={s.queue_depth}, admitted={s.admitted}, "
+            f"shed={s.shed})"
+        )

@@ -16,22 +16,34 @@ indefinite block. This module provides that failure model:
   built on ``Interruptible.synchronize(timeout_s=...)``. Retries call
   the SAME function object, so a jitted program is re-dispatched from
   jax's compile cache: a retry costs dispatch, not compile
-  (tests/test_resilience.py audits trace and dispatch counts).
+  (tests/test_resilience.py audits trace and dispatch counts);
+* :class:`HedgePolicy` + :func:`dispatch_hedged` — tail-latency
+  hedging: when the primary dispatch is still not ready after a
+  percentile-derived hedge delay, a backup is dispatched and the FIRST
+  ready answer wins (the "tied requests" pattern; the loser's device
+  work completes in the background — cooperative semantics, exactly
+  like an abandoned retry). Deterministic under
+  ``raft_tpu.testing.faults`` stragglers, so the chaos suite replays.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 import time
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
+import jax
 import numpy as np
 
 from raft_tpu import errors
 from raft_tpu.core.interruptible import Interruptible
 
-__all__ = ["Deadline", "RetryPolicy", "dispatch_with_deadline"]
+__all__ = [
+    "Deadline", "RetryPolicy", "dispatch_with_deadline",
+    "HedgePolicy", "dispatch_hedged",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,3 +205,209 @@ def dispatch_with_deadline(
                 on_retry(attempt, exc, sleep_s)
             if sleep_s > 0:
                 time.sleep(sleep_s)
+
+
+class HedgePolicy:
+    """Percentile-based hedge-delay tuning + hedge outcome counters
+    (thread-safe).
+
+    The hedge delay is the latency percentile at which a dispatch is
+    declared "probably straggling" and worth backing up: hedging at p95
+    bounds the extra dispatch load to ~5% of traffic while cutting the
+    tail above it to roughly ``delay + p50`` (the classic tied-requests
+    tradeoff — docs/robustness.md "hedge-delay tuning").
+    :func:`dispatch_hedged` records every completed dispatch's latency
+    here, so the delay adapts to the measured distribution;
+    ``default_delay_s`` serves until ``min_samples`` have been seen, and
+    ``min_delay_s``/``max_delay_s`` clamp the estimate (a hedge delay
+    below the dispatch cost would double EVERY request's load).
+
+    Counters: ``primary_wins`` / ``backup_wins`` count hedged races by
+    winner; ``hedges`` counts backup dispatches (the added-load
+    metric); ``unhedged`` counts dispatches the primary won before the
+    delay expired.
+    """
+
+    def __init__(self, *, percentile: float = 95.0,
+                 default_delay_s: float = 0.05,
+                 min_delay_s: float = 0.0,
+                 max_delay_s: float = 10.0,
+                 window: int = 1024, min_samples: int = 16):
+        errors.expects(
+            0.0 < percentile <= 100.0,
+            "HedgePolicy: percentile=%s out of range (0, 100]", percentile,
+        )
+        errors.expects(
+            min_delay_s <= max_delay_s,
+            "HedgePolicy: min_delay_s=%s > max_delay_s=%s",
+            min_delay_s, max_delay_s,
+        )
+        errors.expects(
+            window >= 1 and min_samples >= 1,
+            "HedgePolicy: window=%d / min_samples=%d must be >= 1",
+            window, min_samples,
+        )
+        self.percentile = float(percentile)
+        self.default_delay_s = float(default_delay_s)
+        self.min_delay_s = float(min_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self._lock = threading.Lock()
+        self._samples: List[float] = []
+        self.hedges = 0
+        self.unhedged = 0
+        self.primary_wins = 0
+        self.backup_wins = 0
+
+    def record(self, seconds: float) -> None:
+        """Record one completed dispatch's latency (a bounded sliding
+        window of the most recent ``window`` samples)."""
+        with self._lock:
+            self._samples.append(float(seconds))
+            if len(self._samples) > self.window:
+                del self._samples[: len(self._samples) - self.window]
+
+    @property
+    def n_samples(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def hedge_delay_s(self) -> float:
+        """The current hedge delay: the configured latency percentile of
+        the recorded window, clamped to [min_delay_s, max_delay_s];
+        ``default_delay_s`` (clamped) until ``min_samples`` samples."""
+        with self._lock:
+            if len(self._samples) < self.min_samples:
+                est = self.default_delay_s
+            else:
+                est = float(
+                    np.percentile(np.asarray(self._samples),
+                                  self.percentile)
+                )
+        return min(self.max_delay_s, max(self.min_delay_s, est))
+
+
+def _ready_leaves(x) -> list:
+    return [
+        leaf for leaf in jax.tree.leaves(x) if hasattr(leaf, "is_ready")
+    ]
+
+
+def _wait_first(candidates, *, timeout_s: Optional[float],
+                poll_interval_s: float = 0.0005,
+                max_poll_interval_s: float = 0.02) -> int:
+    """Index of the FIRST fully-ready candidate (every ``is_ready`` leaf
+    ready), polling with the same cancellable backoff loop as
+    ``Interruptible.synchronize``; :class:`raft_tpu.errors.RaftTimeoutError`
+    if none is ready within ``timeout_s``."""
+    pending = [_ready_leaves(c) for c in candidates]
+    deadline = (
+        None if timeout_s is None else time.monotonic() + timeout_s
+    )
+    interval = poll_interval_s
+    while True:
+        Interruptible.yield_now()
+        for i, leaves in enumerate(pending):
+            pending[i] = [leaf for leaf in leaves if not leaf.is_ready()]
+            if not pending[i]:
+                return i
+        if deadline is not None and time.monotonic() >= deadline:
+            raise errors.RaftTimeoutError(
+                "dispatch_hedged: neither primary nor backup ready "
+                f"within {timeout_s:.3g}s"
+            )
+        time.sleep(interval)
+        interval = min(interval * 2.0, max_poll_interval_s)
+
+
+def dispatch_hedged(
+    fn: Callable[..., Any], *args: Any,
+    hedge: "HedgePolicy | float" = 0.05,
+    backup_fn: Optional[Callable[..., Any]] = None,
+    timeout_s: Optional[float] = None,
+    deadline: Optional[Deadline] = None,
+    on_hedge: Optional[Callable[[float], None]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Dispatch ``fn(*args, **kwargs)`` and, if it is still not ready
+    after the hedge delay, dispatch a backup — the first ready answer
+    wins (Dean & Barroso's "tied requests": the p99 of a hedged
+    dispatch collapses toward ``hedge_delay + p50``, because a
+    straggling chip no longer holds the answer hostage).
+
+    * ``hedge``: a :class:`HedgePolicy` (percentile-adaptive, records
+      every completed latency and counts outcomes) or a fixed delay in
+      seconds;
+    * ``backup_fn``: the backup dispatch (default: ``fn`` again — on a
+      replicated deployment pass the OTHER replica's entry point, so
+      the backup cannot land on the same straggling chip);
+    * ``timeout_s`` / ``deadline``: overall wait bound across both
+      dispatches (the tighter wins), raising
+      :class:`raft_tpu.errors.RaftTimeoutError` — measured from entry,
+      so the hedge delay spends the same budget;
+    * ``on_hedge(delay_s)``: observability hook, called once when the
+      backup is actually dispatched.
+
+    The LOSER is abandoned, not preempted: its device work completes in
+    the background (cooperative semantics, exactly like a
+    ``dispatch_with_deadline`` retry past a straggler), and its output
+    buffers are dropped with the reference. Hedging therefore costs up
+    to one duplicate dispatch per hedge — bound it by hedging at a high
+    percentile. Like retries, hedging and BUFFER DONATION do not mix:
+    a donated batch is consumed by the primary dispatch, so the backup
+    would re-dispatch deleted arrays; keep donation off or have ``fn``
+    materialize a fresh batch per call.
+
+    Deterministic under injected faults: with
+    ``raft_tpu.testing.faults.inject_delay``/``inject_straggler``
+    gating readiness on the host clock, the same fault schedule yields
+    the same winner every run (the chaos suite replays bit-for-bit).
+    """
+    policy = hedge if isinstance(hedge, HedgePolicy) else None
+    delay_s = (
+        policy.hedge_delay_s() if policy is not None else float(hedge)
+    )
+    errors.expects(
+        delay_s >= 0, "dispatch_hedged: hedge delay %s < 0", delay_s
+    )
+    overall = Deadline.unbounded() if deadline is None else deadline
+    if timeout_s is not None:
+        overall = Deadline(
+            min(overall.expires_at, time.monotonic() + timeout_s)
+        )
+    t0 = time.monotonic()
+    primary = fn(*args, **kwargs)
+    first_wait = delay_s
+    if overall.bounded:
+        first_wait = min(first_wait, overall.remaining())
+    try:
+        Interruptible.synchronize(primary, timeout_s=first_wait)
+        if policy is not None:
+            policy.record(time.monotonic() - t0)
+            with policy._lock:
+                policy.unhedged += 1
+        return primary
+    except errors.RaftTimeoutError:
+        if overall.bounded and overall.expired():
+            raise  # the budget, not the hedge delay, ended the wait
+    if policy is not None:
+        with policy._lock:
+            policy.hedges += 1
+    if on_hedge is not None:
+        on_hedge(delay_s)
+    backup = (backup_fn if backup_fn is not None else fn)(
+        *args, **kwargs
+    )
+    winner = _wait_first(
+        (primary, backup),
+        timeout_s=overall.remaining() if overall.bounded else None,
+    )
+    if policy is not None:
+        policy.record(time.monotonic() - t0)
+        with policy._lock:
+            if winner == 0:
+                policy.primary_wins += 1
+            else:
+                policy.backup_wins += 1
+    return primary if winner == 0 else backup

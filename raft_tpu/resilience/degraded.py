@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import compat, errors
-from raft_tpu.resilience.health import ShardHealth
+from raft_tpu.resilience.health import HealthReport, ShardHealth
 
 __all__ = ["PartialSearchResult", "resolve_shard_mask"]
 
@@ -79,12 +79,17 @@ class PartialSearchResult:
 def resolve_shard_mask(shard_mask: Any, n_ranks: int) -> np.ndarray:
     """Normalize a ``shard_mask=`` argument to an int32 ``(P,)`` validity
     array (1 = up). Accepts ``True`` (all ranks up — the degraded result
-    type without any masking), a :class:`ShardHealth`, or any array-like
-    of per-rank truth. All-down is allowed: every slot merges to +inf
-    and coverage is 0 — the caller sees a fully partial result, not an
-    exception (degrade, don't fail)."""
+    type without any masking), a :class:`ShardHealth`, a
+    :class:`HealthReport` (folded through a fresh tracker via
+    :meth:`ShardHealth.apply_report`, so the health-check → mask
+    pipeline is one call), or any array-like of per-rank truth.
+    All-down is allowed: every slot merges to +inf and coverage is 0 —
+    the caller sees a fully partial result, not an exception (degrade,
+    don't fail)."""
     if shard_mask is True:
         return np.ones(n_ranks, np.int32)
+    if isinstance(shard_mask, HealthReport):
+        shard_mask = ShardHealth(n_ranks).apply_report(shard_mask)
     if isinstance(shard_mask, ShardHealth):
         arr = shard_mask.mask()
     else:

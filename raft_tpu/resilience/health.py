@@ -24,7 +24,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -84,6 +84,26 @@ class ShardHealth:
         with self._lock:
             return bool(self._up.all())
 
+    def apply_report(self, report: "HealthReport") -> "ShardHealth":
+        """Fold a :class:`HealthReport` into the tracker: every rank
+        implicated by a FAILED probe is marked down — a failed probe
+        carrying rank attribution (``HealthProbe.ranks``, e.g. a
+        per-rank heartbeat sweep) downs exactly those ranks; one with
+        no attribution downs EVERY rank, because a collective that
+        cannot round-trip means no mesh program can run at all. Passing
+        probes mark nothing up (recovery of an externally-downed rank
+        is the external system's call — flip it back with ``mark_up``
+        after :func:`raft_tpu.comms.mnmg_ivf.recover_rank`). Returns
+        ``self``, so the health-check → mask pipeline is one
+        expression: ``health.apply_report(report).mask()``."""
+        for probe in report.probes.values():
+            if probe.ok:
+                continue
+            ranks = probe.ranks or tuple(range(self.n_ranks))
+            for r in ranks:
+                self.mark_down(r)
+        return self
+
     def mask(self) -> np.ndarray:
         """Snapshot the validity mask as int32 ``(P,)`` (1 = up)."""
         with self._lock:
@@ -100,10 +120,17 @@ class ShardHealth:
 
 @dataclasses.dataclass(frozen=True)
 class HealthProbe:
-    """One collective's round-trip result: pass/fail + wall time."""
+    """One probe's result: pass/fail + wall time.
+
+    ``ranks`` optionally attributes a FAILURE to specific ranks (a
+    per-rank heartbeat/liveness probe); empty means the probe speaks
+    for the whole mesh — :meth:`ShardHealth.apply_report` downs every
+    rank on an unattributed failure. The collective self-test sweep
+    (:func:`health_check`) emits unattributed probes."""
 
     ok: bool
     seconds: float
+    ranks: Tuple[int, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,9 +189,10 @@ def health_check(comms, *, health: Optional[ShardHealth] = None,
             ok = False
         probes[name] = HealthProbe(ok=ok, seconds=time.perf_counter() - t0)
     report = HealthReport(probes=probes)
-    if not report.ok and health is not None:
-        for r in range(health.n_ranks):
-            health.mark_down(r)
+    if health is not None:
+        # unattributed collective failures down every rank (see
+        # ShardHealth.apply_report); a passing sweep marks nothing up
+        health.apply_report(report)
     if raise_on_failure and not report.ok:
         raise errors.RaftException(
             f"health_check: collectives failed round-trip: {report.failed}"

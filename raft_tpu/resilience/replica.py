@@ -1,0 +1,253 @@
+"""R-way shard replication placement + runtime failover routing.
+
+PR 3's ``shard_mask`` degrades gracefully when a rank dies — but the
+dead rank's lists are simply GONE from every answer (``coverage`` drops
+below 1.0 and stays there until a rebuild). At the ROADMAP's serving
+scale a single-chip failure must not cost recall, so the sharded
+engines support **R-way replication**: every logical shard's lists are
+stored on R ranks (striped — logical shard ``s`` lives on ranks
+``{(s + j*offset) % P}``), and a runtime routing input selects WHICH
+replica copy serves each shard. With at most R-1 failures per replica
+group, coverage stays 1.0 and results are identical to the healthy
+mesh; only a whole dead replica group degrades to the PR 3 partial
+path.
+
+This module carries the host-side placement/routing logic — pure numpy,
+no mesh required, so a control plane can plan failovers without
+touching a device:
+
+* :class:`ReplicaPlacement` — the striped shard→ranks map, mirroring
+  the slab layout :func:`raft_tpu.comms.mnmg_ivf.replicate_index`
+  builds (``place_index(..., replication=R)``);
+* :class:`FailoverPlan` — maps a :class:`ShardHealth` (or mask) +
+  placement to the ``(P,)`` int32 ``route`` array the degraded search
+  programs take as a RUNTIME input (``route[s]`` = the replica copy
+  index currently serving logical shard ``s``; -1 = whole group dead).
+  Health flips change route VALUES only — the compiled program never
+  retraces (trace-audited in tests/test_resilience.py).
+
+The memory cost is exactly R× the slab footprint (lists, rows, codes);
+quantizers and ownership maps were already replicated. docs/robustness.md
+"Replication & failover" states the full contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import numpy as np
+
+from raft_tpu import errors
+
+__all__ = ["ReplicaPlacement", "FailoverPlan", "resolve_route"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaPlacement:
+    """The striped shard→ranks map of an R-way replicated sharded index.
+
+    Logical shard ``s`` (one per mesh rank; the unit of LPT ownership)
+    is stored on ranks ``{(s + j*offset) % n_ranks for j in range(R)}``
+    — copy 0 is the PRIMARY (the rank that serves it on a healthy
+    mesh), copies 1..R-1 are standbys. Rank ``r`` therefore stores the
+    segments of shards ``{(r - j*offset) % n_ranks}``, primary first —
+    exactly the slab order :func:`raft_tpu.comms.mnmg_ivf.replicate_index`
+    lays out.
+    """
+
+    n_ranks: int
+    replication: int
+    offset: int
+
+    @classmethod
+    def striped(cls, n_ranks: int, replication: int,
+                offset: "int | None" = None) -> "ReplicaPlacement":
+        """The standard placement. ``offset`` defaults to
+        ``max(1, n_ranks // replication)`` — for R=2 that pairs rank
+        ``r`` with ``r + P/2``, so a correlated failure of ADJACENT
+        ranks (one host's chips) never takes out both copies of a
+        shard. Any offset is accepted as long as every shard's R
+        holders are distinct ranks."""
+        if offset is None:
+            offset = max(1, n_ranks // max(replication, 1))
+        errors.expects(
+            1 <= replication <= n_ranks,
+            "replication=%d out of range [1, n_ranks=%d] — a rank "
+            "cannot hold two copies of the same shard",
+            replication, n_ranks,
+        )
+        errors.expects(offset >= 1, "offset=%d < 1", offset)
+        for delta in range(1, replication):
+            errors.expects(
+                (delta * offset) % n_ranks != 0,
+                "offset=%d collides copies %d apart on a %d-rank mesh "
+                "(two copies of one shard would land on the same rank)",
+                offset, delta, n_ranks,
+            )
+        return cls(n_ranks=n_ranks, replication=replication, offset=offset)
+
+    @classmethod
+    def of_index(cls, index) -> "ReplicaPlacement":
+        """The placement a replicated sharded index was built with
+        (``place_index(..., replication=R)`` stamps the statics)."""
+        return cls(
+            n_ranks=int(index.sorted_ids.shape[0]),
+            replication=int(getattr(index, "replication", 1) or 1),
+            offset=int(getattr(index, "replica_offset", 1) or 1),
+        )
+
+    def holders(self, shard: int) -> Tuple[int, ...]:
+        """The ranks storing ``shard``'s lists, primary (copy 0) first."""
+        errors.expects(
+            0 <= shard < self.n_ranks,
+            "shard %d out of range [0, %d)", shard, self.n_ranks,
+        )
+        return tuple(
+            (shard + j * self.offset) % self.n_ranks
+            for j in range(self.replication)
+        )
+
+    def segments(self, rank: int) -> Tuple[int, ...]:
+        """The logical shards stored on ``rank``, in slab-segment order
+        (segment 0 = the rank's own primary shard)."""
+        errors.expects(
+            0 <= rank < self.n_ranks,
+            "rank %d out of range [0, %d)", rank, self.n_ranks,
+        )
+        return tuple(
+            (rank - j * self.offset) % self.n_ranks
+            for j in range(self.replication)
+        )
+
+    @property
+    def memory_factor(self) -> int:
+        """Slab-memory multiplier vs the unreplicated index (exactly R:
+        lists, rows, and codes are stored R times; quantizers and
+        ownership maps were already replicated)."""
+        return self.replication
+
+
+def _alive_mask(health: Any, n_ranks: int) -> np.ndarray:
+    # local import: degraded.py is jax-importing; keep this module
+    # usable from a mesh-free control plane unless a mask must resolve
+    from raft_tpu.resilience.degraded import resolve_shard_mask
+
+    return resolve_shard_mask(health, n_ranks)
+
+
+@dataclasses.dataclass(frozen=True)
+class FailoverPlan:
+    """A routing decision: which replica copy serves each logical shard.
+
+    ``route`` is the ``(P,)`` int32 RUNTIME input of the degraded
+    sharded search programs: ``route[s]`` is the copy index ``j`` such
+    that rank ``(s + j*offset) % P`` serves shard ``s``'s lists; ``-1``
+    means every holder is down and the shard goes unserved (the search
+    degrades to the PR 3 partial path for exactly those probes). A
+    healthy mesh routes everything to copy 0 — the all-zeros route is
+    the default when no plan is passed.
+
+    Each shard is served by EXACTLY ONE rank under any plan, so merged
+    results carry no duplicates and — whenever ``fully_covered`` — are
+    identical to the healthy mesh's (every list is scored by the same
+    kernel over an identical replica of its rows; only which allgather
+    part carries the contribution changes).
+    """
+
+    placement: ReplicaPlacement
+    route: np.ndarray
+
+    @classmethod
+    def from_health(cls, placement: ReplicaPlacement,
+                    health: Any) -> "FailoverPlan":
+        """Route every shard to its FIRST live holder (primary wins when
+        up, so a healthy mesh yields the all-zeros route and flipping a
+        rank back up restores primary serving). ``health`` is anything
+        :func:`raft_tpu.resilience.resolve_shard_mask` accepts — a
+        :class:`ShardHealth`, a :class:`HealthReport`, a ``(P,)``
+        array-like, or ``True``."""
+        alive = _alive_mask(health, placement.n_ranks)
+        route = np.full(placement.n_ranks, -1, np.int32)
+        for s in range(placement.n_ranks):
+            for j, r in enumerate(placement.holders(s)):
+                if alive[r]:
+                    route[s] = j
+                    break
+        return cls(placement=placement, route=route)
+
+    @property
+    def fully_covered(self) -> bool:
+        """True iff every logical shard has a live serving rank — the
+        zero-coverage-loss regime (≤ R-1 failures per replica group)."""
+        return bool((self.route >= 0).all())
+
+    @property
+    def unserved_shards(self) -> list:
+        """Logical shards with no live holder (whole group dead)."""
+        return np.nonzero(self.route < 0)[0].tolist()
+
+    def serving_rank(self, shard: int) -> int:
+        """The rank currently serving ``shard`` (-1 = unserved)."""
+        j = int(self.route[shard])
+        if j < 0:
+            return -1
+        return self.placement.holders(shard)[j]
+
+    def serving_load(self) -> np.ndarray:
+        """Shards served per rank, ``(P,)`` int — 1 everywhere on a
+        healthy mesh; a failover rank carries 2+ (its grouped search
+        scans more non-empty lists, so size ``qcap``/latency budgets
+        for the failover load, not the healthy one)."""
+        load = np.zeros(self.placement.n_ranks, np.int64)
+        for s in range(self.placement.n_ranks):
+            r = self.serving_rank(s)
+            if r >= 0:
+                load[r] += 1
+        return load
+
+    def __repr__(self) -> str:  # compact operator-facing summary
+        moved = np.nonzero(self.route > 0)[0].tolist()
+        dead = self.unserved_shards
+        return (
+            f"FailoverPlan(P={self.placement.n_ranks}, "
+            f"R={self.placement.replication}, failed_over={moved}, "
+            f"unserved={dead})"
+        )
+
+
+def resolve_route(failover: Any, n_ranks: int, replication: int,
+                  offset: int) -> np.ndarray:
+    """Normalize a search's ``failover=`` argument to the ``(P,)`` int32
+    route array the compiled degraded program consumes. Accepts ``None``
+    (healthy: all copy 0), a :class:`FailoverPlan` (its placement must
+    match the index's replication geometry — a plan built for a
+    different stripe would route probes into the wrong slab segments),
+    or an explicit ``(P,)`` array of copy indices in ``[-1, R)``."""
+    if failover is None:
+        return np.zeros(n_ranks, np.int32)
+    if isinstance(failover, FailoverPlan):
+        p = failover.placement
+        errors.expects(
+            p.n_ranks == n_ranks and p.replication == replication
+            and (replication == 1 or p.offset == offset),
+            "failover plan placement (P=%d, R=%d, offset=%d) does not "
+            "match the index layout (P=%d, R=%d, offset=%d)",
+            p.n_ranks, p.replication, p.offset,
+            n_ranks, replication, offset,
+        )
+        arr = failover.route
+    else:
+        arr = np.asarray(failover)
+    errors.expects(
+        arr.shape == (n_ranks,),
+        "failover route: expected shape (%d,), got %s",
+        n_ranks, tuple(arr.shape),
+    )
+    arr = arr.astype(np.int32)
+    errors.expects(
+        bool(((arr >= -1) & (arr < replication)).all()),
+        "failover route entries must be replica copy indices in "
+        "[-1, %d)", replication,
+    )
+    return arr

@@ -241,7 +241,13 @@ def _rebuild(cls, prefix: str, npz, static: dict, placer=_default_placer):
                 arr = arr.view(np.dtype(getattr(ml_dtypes, tagged)))
             kwargs[f.name] = placer(f.name, arr)
         else:
-            v = static.get(key)
+            if key not in static:
+                # field absent from the archive entirely: a checkpoint
+                # written before the field existed (e.g. the sharded
+                # indexes' replication statics) — leave it to the
+                # dataclass default rather than forcing None
+                continue
+            v = static[key]
             if isinstance(v, dict) and "__nested__" in v:
                 errors.expects(
                     v["__nested__"] in _NESTED,

@@ -39,6 +39,7 @@ __all__ = [
     "FaultAudit",
     "DelayedReady",
     "inject_delay",
+    "inject_straggler",
     "inject_nonfinite",
     "corrupt_bytes",
     "cancel_after",
@@ -130,6 +131,36 @@ def inject_delay(seconds: float, *, first_n: Optional[int] = None):
         )
 
     return fn, audit
+
+
+def inject_straggler(fn, *, every: int, seconds: float):
+    """Wrap an arbitrary dispatch function so every ``every``-th call's
+    result polls not-ready for ``seconds`` past dispatch — the periodic
+    slow-chip surrogate for tail-latency experiments (the hedging bench
+    drives its p99 measurement through this; ``dispatch_hedged``'s
+    winner is deterministic against it because the straggle schedule is
+    exactly periodic, not sampled).
+
+    Unlike :func:`inject_delay` (which wraps its own audited identity
+    program), this wraps the caller's real ``fn`` — the returned value
+    is ``fn``'s output, wrapped in a :class:`DelayedReady` on straggling
+    calls. Returns ``(wrapped, audit)``; ``audit.calls`` counts
+    invocations (``audit.dispatches`` counts the straggled ones)."""
+    errors.expects(every >= 1, "inject_straggler: every=%d < 1", every)
+    errors.expects(
+        seconds >= 0, "inject_straggler: seconds=%s < 0", seconds
+    )
+    audit = FaultAudit()
+
+    def wrapped(*args, **kwargs):
+        audit.calls += 1
+        out = fn(*args, **kwargs)
+        if audit.calls % every == 0:
+            audit.dispatches += 1
+            return DelayedReady(out, time.monotonic() + seconds)
+        return out
+
+    return wrapped, audit
 
 
 def inject_nonfinite(x, rows: Sequence[int], *,

@@ -196,6 +196,16 @@ class TestOperationalErrors:
     def test_exported(self):
         assert "RaftTimeoutError" in errors.__all__
         assert "CorruptIndexError" in errors.__all__
+        assert "RaftOverloadError" in errors.__all__
+
+    def test_overload_hierarchy_and_retry_after(self):
+        e = errors.RaftOverloadError("queue full", retry_after_s=0.25)
+        assert isinstance(e, errors.RaftException)
+        assert not isinstance(e, ValueError)   # PR 3 pattern: loud, typed
+        assert not isinstance(e, TimeoutError)  # overload != deadline
+        assert e.retry_after_s == 0.25
+        assert "RAFT failure at" in str(e) and "queue full" in str(e)
+        assert errors.RaftOverloadError("no estimate").retry_after_s is None
 
     def test_timeout_hierarchy(self):
         e = errors.RaftTimeoutError("deadline blown")
@@ -226,3 +236,4 @@ class TestOperationalErrors:
         assert classify(errors.RaftLogicError("k too big")) == "bad-argument"
         assert classify(errors.RaftTimeoutError("slow")) == "operational"
         assert classify(errors.CorruptIndexError("crc")) == "operational"
+        assert classify(errors.RaftOverloadError("full")) == "operational"

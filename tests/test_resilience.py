@@ -6,7 +6,14 @@ end-to-end on the 8-device virtual CPU mesh, in tier-1:
   WITHOUT recompiling (trace/dispatch counts audited);
 * a fail_rank-masked shard yields a partial=True result whose valid
   entries exactly match a healthy search restricted to the surviving
-  shards;
+  shards (parametrized over replication ∈ {1, 2});
+* with R=2 replication and a FailoverPlan, a down rank's lists serve
+  from their replica: coverage stays 1.0 and results are BIT-IDENTICAL
+  to the healthy mesh, with zero retraces across the health flip;
+* recover_rank restores a downed rank's slabs from a checkpoint and
+  routing flips back — no rebuild;
+* hedged dispatch beats an injected straggler deterministically;
+  admission control sheds with RaftOverloadError, never collapses;
 * a corrupt_bytes-damaged checkpoint raises CorruptIndexError naming
   the field, while an intact v1 (pre-manifest) file still loads;
 * a batch with injected NaN rows returns finite top-k for all valid
@@ -19,6 +26,8 @@ The failure-model rationale lives in docs/robustness.md.
 
 import json
 import os
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -33,16 +42,24 @@ from raft_tpu.comms import (
     mnmg_ivf_pq_build,
     mnmg_ivf_pq_search,
     place_index,
+    recover_rank,
+    replicate_index,
     reshard_index,
 )
 from raft_tpu.resilience import (
+    AdmissionController,
     Deadline,
+    FailoverPlan,
+    HedgePolicy,
     PartialSearchResult,
+    ReplicaPlacement,
     RetryPolicy,
     ShardHealth,
+    dispatch_hedged,
     dispatch_with_deadline,
     health_check,
 )
+from raft_tpu.resilience.health import HealthProbe, HealthReport
 from raft_tpu.spatial.ann import (
     IVFFlatParams,
     IVFPQParams,
@@ -263,25 +280,42 @@ def flat_index(comms8, dataset):
     return mnmg_ivf_flat_build(comms8, x, FLAT_PARAMS)
 
 
-@pytest.fixture(scope="module", params=["flat_probe", "two_level_probe"])
-def probed_index(request, flat_index):
-    """The degraded-search suite runs under BOTH coarse probes: the flat
-    centroid scan and the two-level CoarseIndex probe must produce
-    identical PartialSearchResult semantics (shard_mask with a down
-    rank, owner=-1 probe-set extras, NaN query rows)."""
-    if request.param == "two_level_probe":
+@pytest.fixture(scope="module", params=[
+    ("flat_probe", 1), ("two_level_probe", 1),
+    ("flat_probe", 2), ("two_level_probe", 2),
+], ids=lambda p: f"{p[0]}-r{p[1]}")
+def probed_index(request, comms8, flat_index):
+    """The degraded-search suite runs under BOTH coarse probes (flat
+    centroid scan vs two-level CoarseIndex) AND under replication ∈
+    {1, 2}: the PartialSearchResult semantics (shard_mask with a down
+    rank, owner=-1 probe-set extras, NaN query rows) must be identical
+    in all four layouts — an unrouted replicated index serves primaries
+    exactly like the unreplicated one."""
+    probe, replication = request.param
+    idx = flat_index
+    if replication > 1:
+        idx = place_index(comms8, idx, replication=replication)
+    if probe == "two_level_probe":
         from raft_tpu.comms import attach_coarse_index
 
-        return attach_coarse_index(flat_index, seed=0)
-    return flat_index
+        idx = attach_coarse_index(idx, seed=0)
+    return idx
+
+
+@pytest.fixture(scope="module")
+def replicated_flat(comms8, flat_index):
+    """The R=2 striped replica layout of the flat suite's index."""
+    return place_index(comms8, flat_index, replication=2)
 
 
 def _rank_row_ids(index, rank):
-    """GLOBAL row ids owned by ``rank`` (host-side, from the slab
-    layout: the valid region is [0, list_offsets[rank, -1]))."""
+    """GLOBAL row ids whose PRIMARY owner is ``rank`` (host-side, from
+    the slab layout: the primary segment is the first nl_pad/R lists,
+    so its rows are [0, list_offsets[rank, nl_pad/R]))."""
     offs = np.asarray(index.list_offsets)
     sids = np.asarray(index.sorted_ids)
-    return sids[rank, : offs[rank, -1]]
+    nlp_base = index.nl_pad // int(getattr(index, "replication", 1) or 1)
+    return sids[rank, : offs[rank, nlp_base]]
 
 
 def test_all_up_mask_matches_healthy_search(comms8, dataset, probed_index):
@@ -499,6 +533,606 @@ def test_two_level_probe_health_flip_zero_retrace(
         comms8, idx, q, K, shard_mask=m_one, overprobe=3.0, **kw
     )
     assert created[-1] is fn2 and fn2._cache_size() == size2
+
+
+# ---------------------------------------------------------------------------
+# R-way replication + failover (resilience/replica.py)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaPlacement:
+    def test_striped_holders_and_segments(self):
+        p = ReplicaPlacement.striped(8, 2)     # default offset P//R = 4
+        assert p.offset == 4
+        assert p.holders(1) == (1, 5)
+        assert p.segments(5) == (5, 1)
+        assert p.memory_factor == 2
+        p3 = ReplicaPlacement.striped(8, 3, offset=1)
+        assert p3.holders(6) == (6, 7, 0)
+
+    def test_colliding_offset_rejected(self):
+        with pytest.raises(ValueError, match="collides"):
+            ReplicaPlacement.striped(8, 2, offset=8)
+        with pytest.raises(ValueError, match="collides"):
+            ReplicaPlacement.striped(8, 3, offset=4)  # 2*4 % 8 == 0
+
+    def test_replication_bounds(self):
+        with pytest.raises(ValueError, match="replication"):
+            ReplicaPlacement.striped(4, 5)
+        with pytest.raises(ValueError, match="replication"):
+            ReplicaPlacement.striped(4, 0)
+
+
+class TestFailoverPlan:
+    def test_healthy_routes_primaries(self):
+        p = ReplicaPlacement.striped(8, 2)
+        plan = FailoverPlan.from_health(p, True)
+        np.testing.assert_array_equal(plan.route, np.zeros(8))
+        assert plan.fully_covered
+        np.testing.assert_array_equal(plan.serving_load(), np.ones(8))
+
+    def test_single_failure_routes_to_replica(self):
+        p = ReplicaPlacement.striped(8, 2)
+        plan = FailoverPlan.from_health(p, faults.fail_rank(8, 2))
+        assert plan.fully_covered
+        assert plan.route[2] == 1 and plan.serving_rank(2) == 6
+        assert (plan.route[np.arange(8) != 2] == 0).all()
+        load = plan.serving_load()
+        assert load[2] == 0 and load[6] == 2  # rank 6 carries both
+
+    def test_whole_group_dead_unserved(self):
+        p = ReplicaPlacement.striped(8, 2)
+        plan = FailoverPlan.from_health(p, faults.fail_rank(8, 3, 7))
+        # shards 3 and 7 share holders {3, 7}: both groups are dead
+        assert not plan.fully_covered
+        assert plan.unserved_shards == [3, 7]
+        assert plan.serving_rank(3) == -1
+
+
+def test_replicated_layout_geometry(flat_index, replicated_flat):
+    base, rep = flat_index, replicated_flat
+    assert rep.replication == 2 and rep.replica_offset == 4
+    assert rep.nl_pad == 2 * base.nl_pad
+    # primary segment 0 is byte-identical to the base layout (healthy
+    # serving reads it with unchanged local ids/offsets), segment 1 is
+    # the replica partner's primary
+    szs_b = np.asarray(base.list_sizes)
+    szs_r = np.asarray(rep.list_sizes)
+    for r in range(8):
+        np.testing.assert_array_equal(szs_r[r, : base.nl_pad], szs_b[r])
+        np.testing.assert_array_equal(
+            szs_r[r, base.nl_pad:], szs_b[(r - 4) % 8]
+        )
+    # every rank's replica segment carries its partner's primary rows
+    for r in range(8):
+        partner = (r - 4) % 8
+        prim = np.sort(_rank_row_ids(rep, r))
+        np.testing.assert_array_equal(
+            prim, np.sort(_rank_row_ids(flat_index, r))
+        )
+        offs = np.asarray(rep.list_offsets)
+        sids = np.asarray(rep.sorted_ids)
+        seg1 = sids[r, offs[r, base.nl_pad]: offs[r, -1]]
+        np.testing.assert_array_equal(
+            np.sort(seg1), np.sort(_rank_row_ids(flat_index, partner))
+        )
+
+
+def test_failover_full_coverage_bit_identical(
+    comms8, dataset, replicated_flat
+):
+    """THE tentpole acceptance: R=2, any single rank down, failover
+    routed — coverage 1.0 everywhere and results BIT-IDENTICAL to the
+    healthy mesh."""
+    _, q = dataset
+    v0, i0 = mnmg_ivf_flat_search(
+        comms8, replicated_flat, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    placement = ReplicaPlacement.of_index(replicated_flat)
+    for dead in range(8):
+        health = faults.fail_rank(ShardHealth(8), dead)
+        plan = FailoverPlan.from_health(placement, health)
+        assert plan.fully_covered
+        res = mnmg_ivf_flat_search(
+            comms8, replicated_flat, q, K, n_probes=8, qcap=q.shape[0],
+            shard_mask=health, failover=plan,
+        )
+        assert isinstance(res, PartialSearchResult)
+        assert res.partial is False
+        np.testing.assert_array_equal(np.asarray(res.coverage), 1.0)
+        np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(i0))
+        np.testing.assert_array_equal(
+            np.asarray(res.distances), np.asarray(v0)
+        )
+
+
+def test_failover_pq_engine_bit_identical(comms8, dataset):
+    x, q = dataset
+    idx = mnmg_ivf_pq_build(
+        comms8, x,
+        IVFPQParams(n_lists=8, pq_dim=4, kmeans_n_iters=3, seed=5),
+    )
+    v0, i0 = mnmg_ivf_pq_search(comms8, idx, q, K, n_probes=8,
+                                qcap=q.shape[0])
+    ridx = place_index(comms8, idx, replication=2)
+    health = faults.fail_rank(ShardHealth(8), 5)
+    plan = FailoverPlan.from_health(
+        ReplicaPlacement.of_index(ridx), health
+    )
+    res = mnmg_ivf_pq_search(
+        comms8, ridx, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=health, failover=plan,
+    )
+    assert res.partial is False and res.min_coverage == 1.0
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(i0))
+    np.testing.assert_array_equal(
+        np.asarray(res.distances), np.asarray(v0)
+    )
+
+
+def test_whole_group_dead_degrades_partial(comms8, dataset,
+                                           replicated_flat):
+    """Both replicas of one group down: the plan routes -1 and the
+    search degrades to the PR 3 partial path for exactly those lists."""
+    _, q = dataset
+    health = faults.fail_rank(ShardHealth(8), 1, 5)  # group {1, 5}
+    plan = FailoverPlan.from_health(
+        ReplicaPlacement.of_index(replicated_flat), health
+    )
+    assert plan.unserved_shards == [1, 5]
+    res = mnmg_ivf_flat_search(
+        comms8, replicated_flat, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=health, failover=plan,
+    )
+    assert res.partial is True
+    cov = np.asarray(res.coverage)
+    assert (cov < 1.0).any()
+    dead_ids = set(_rank_row_ids(replicated_flat, 1).tolist()) | set(
+        _rank_row_ids(replicated_flat, 5).tolist()
+    )
+    live = np.asarray(res.ids)[np.asarray(res.ids) >= 0]
+    assert not (set(live.ravel().tolist()) & dead_ids)
+
+
+def test_failover_flip_zero_retrace(comms8, dataset, replicated_flat,
+                                    monkeypatch):
+    """THE zero-retrace acceptance across failover flips: health down →
+    replica serves → health up, all against ONE compiled program (route
+    and mask are runtime inputs)."""
+    from raft_tpu.comms import mnmg_ivf_flat as mod
+
+    _, q = dataset
+    created = []
+    orig = mod._cached_search
+
+    def recording(*a, **k):
+        fn = orig(*a, **k)
+        created.append(fn)
+        return fn
+
+    monkeypatch.setattr(mod, "_cached_search", recording)
+    placement = ReplicaPlacement.of_index(replicated_flat)
+    kw = dict(n_probes=8, qcap=q.shape[0])
+    health = ShardHealth(8)
+    plan_up = FailoverPlan.from_health(placement, health)
+    r0 = mod.mnmg_ivf_flat_search(
+        comms8, replicated_flat, q, K, shard_mask=health,
+        failover=plan_up, **kw,
+    )
+    fn = created[0]
+    size0 = fn._cache_size()
+    # rank 3 dies; its shard serves from the replica on rank 7
+    health.mark_down(3)
+    plan_down = FailoverPlan.from_health(placement, health)
+    r1 = mod.mnmg_ivf_flat_search(
+        comms8, replicated_flat, q, K, shard_mask=health,
+        failover=plan_down, **kw,
+    )
+    # rank 3 heals; route flips back to the primary
+    health.mark_up(3)
+    r2 = mod.mnmg_ivf_flat_search(
+        comms8, replicated_flat, q, K, shard_mask=health,
+        failover=FailoverPlan.from_health(placement, health), **kw,
+    )
+    assert all(f is fn for f in created), \
+        "failover flips must reuse the cached program object"
+    assert fn._cache_size() == size0, \
+        "failover flips must not retrace the compiled program"
+    for r in (r1, r2):
+        assert r.partial is False
+        np.testing.assert_array_equal(
+            np.asarray(r.ids), np.asarray(r0.ids)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r.distances), np.asarray(r0.distances)
+        )
+
+
+def test_failover_requires_shard_mask(comms8, dataset, replicated_flat):
+    _, q = dataset
+    plan = FailoverPlan.from_health(
+        ReplicaPlacement.of_index(replicated_flat), True
+    )
+    with pytest.raises(ValueError, match="shard_mask"):
+        mnmg_ivf_flat_search(
+            comms8, replicated_flat, q, K, n_probes=8, qcap=q.shape[0],
+            failover=plan,
+        )
+
+
+def test_failover_plan_geometry_mismatch_rejected(
+    comms8, dataset, replicated_flat
+):
+    _, q = dataset
+    bad = FailoverPlan.from_health(ReplicaPlacement.striped(8, 2, 1), True)
+    with pytest.raises(ValueError, match="does not match"):
+        mnmg_ivf_flat_search(
+            comms8, replicated_flat, q, K, n_probes=8, qcap=q.shape[0],
+            shard_mask=True, failover=bad,
+        )
+
+
+def test_replicated_checkpoint_roundtrip_and_reshard(
+    comms8, dataset, replicated_flat, tmp_path
+):
+    """A replicated checkpoint round-trips (layout statics preserved)
+    and restores onto a smaller mesh with replication re-applied."""
+    _, q = dataset
+    v0, i0 = mnmg_ivf_flat_search(
+        comms8, replicated_flat, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    p = tmp_path / "replicated.npz"
+    save_index(replicated_flat, p)
+    back = load_index(p, comms=comms8)
+    assert back.replication == 2 and back.nl_pad == replicated_flat.nl_pad
+    v1, i1 = mnmg_ivf_flat_search(
+        comms8, back, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    comms4 = build_comms(jax.devices()[:4])
+    idx4 = place_index(comms4, back, replication=2)
+    assert idx4.sorted_ids.shape[0] == 4 and idx4.replication == 2
+    plan4 = FailoverPlan.from_health(
+        ReplicaPlacement.of_index(idx4), faults.fail_rank(4, 0)
+    )
+    res4 = mnmg_ivf_flat_search(
+        comms4, idx4, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=faults.fail_rank(4, 0), failover=plan4,
+    )
+    assert res4.partial is False
+    np.testing.assert_array_equal(np.asarray(res4.ids), np.asarray(i0))
+
+
+def test_recover_rank_full_cycle(comms8, dataset, replicated_flat,
+                                 tmp_path):
+    """The heal path end-to-end: rank dies → failover serves (identical
+    results) → replacement rank restores its slabs from the checkpoint
+    (recover_rank) → health up, route back → healthy serving, all
+    results identical throughout."""
+    import dataclasses as dc
+
+    _, q = dataset
+    v0, i0 = mnmg_ivf_flat_search(
+        comms8, replicated_flat, q, K, n_probes=8, qcap=q.shape[0]
+    )
+    p = tmp_path / "ckpt.npz"
+    save_index(replicated_flat, p)
+    placement = ReplicaPlacement.of_index(replicated_flat)
+    dead = 6
+    health = faults.fail_rank(ShardHealth(8), dead)
+    # the dead rank's slab content is LOST (zeroed) — only the replica
+    # and the checkpoint still hold its lists
+    wrecked = dc.replace(
+        replicated_flat,
+        vectors_sorted=jnp.zeros_like(
+            jnp.asarray(replicated_flat.vectors_sorted)
+        ).at[np.arange(8) != dead].set(
+            jnp.asarray(replicated_flat.vectors_sorted)[
+                np.arange(8) != dead
+            ]
+        ),
+        sorted_ids=jnp.asarray(replicated_flat.sorted_ids)
+        .at[dead].set(0),
+    )
+    plan = FailoverPlan.from_health(placement, health)
+    res = mnmg_ivf_flat_search(
+        comms8, wrecked, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=health, failover=plan,
+    )
+    assert res.partial is False
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(i0))
+    # replacement chip joins: restore the slabs, flip health + route
+    healed = recover_rank(comms8, wrecked, p, dead)
+    np.testing.assert_array_equal(
+        np.asarray(healed.sorted_ids)[dead],
+        np.asarray(replicated_flat.sorted_ids)[dead],
+    )
+    health.mark_up(dead)
+    plan_back = FailoverPlan.from_health(placement, health)
+    np.testing.assert_array_equal(plan_back.route, np.zeros(8))
+    res2 = mnmg_ivf_flat_search(
+        comms8, healed, q, K, n_probes=8, qcap=q.shape[0],
+        shard_mask=health, failover=plan_back,
+    )
+    assert res2.partial is False
+    np.testing.assert_array_equal(np.asarray(res2.ids), np.asarray(i0))
+    np.testing.assert_array_equal(
+        np.asarray(res2.distances), np.asarray(v0)
+    )
+
+
+def test_recover_rank_layout_mismatch_rejected(
+    comms8, flat_index, replicated_flat, tmp_path
+):
+    p = tmp_path / "base.npz"
+    save_index(flat_index, p)      # unreplicated checkpoint
+    with pytest.raises(ValueError, match="not a checkpoint of this build"):
+        recover_rank(comms8, replicated_flat, p, 0)
+
+
+def test_replicate_index_rejects_replicated_input(replicated_flat):
+    with pytest.raises(ValueError, match="already"):
+        replicate_index(replicated_flat, 2)
+
+
+# ---------------------------------------------------------------------------
+# Hedged dispatch (resilience/deadline.py) — the straggler tail
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchHedged:
+    def test_backup_wins_on_straggler_without_recompile(self):
+        """Primary straggles past the hedge delay → the backup is
+        dispatched from the SAME compiled program and wins,
+        deterministically."""
+        fn, audit = faults.inject_delay(5.0, first_n=1)
+        pol = HedgePolicy(default_delay_s=0.05, min_samples=100)
+        out = dispatch_hedged(fn, jnp.arange(8.0), hedge=pol)
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+        assert audit.traces == 1, "hedge must reuse the compiled program"
+        assert audit.calls == 2 and audit.dispatches == 2
+        assert pol.hedges == 1 and pol.backup_wins == 1
+        assert pol.primary_wins == 0 and pol.unhedged == 0
+
+    def test_fast_primary_never_hedges(self):
+        fn, audit = faults.inject_delay(0.0)
+        pol = HedgePolicy(default_delay_s=0.25, min_samples=100)
+        out = dispatch_hedged(fn, jnp.arange(4.0), hedge=pol)
+        np.testing.assert_allclose(np.asarray(out), np.arange(4.0))
+        assert audit.calls == 1 and pol.hedges == 0
+        assert pol.unhedged == 1 and pol.n_samples == 1
+
+    def test_backup_fn_used_for_the_hedge(self):
+        slow, _ = faults.inject_delay(5.0)
+        fast_calls = []
+
+        def fast(x):
+            fast_calls.append(1)
+            return jnp.asarray(x) * 1.0
+
+        out = dispatch_hedged(slow, jnp.arange(4.0), hedge=0.02,
+                              backup_fn=fast)
+        np.testing.assert_allclose(np.asarray(out), np.arange(4.0))
+        assert fast_calls == [1]
+
+    def test_deadline_bounds_both_dispatches(self):
+        fn, audit = faults.inject_delay(5.0)   # every call straggles
+        with pytest.raises(errors.RaftTimeoutError):
+            dispatch_hedged(fn, jnp.arange(4.0), hedge=0.02,
+                            timeout_s=0.15)
+        assert audit.calls == 2                # it DID hedge, then gave up
+
+    def test_policy_percentile_adapts(self):
+        pol = HedgePolicy(percentile=50.0, min_samples=2,
+                          min_delay_s=0.0, max_delay_s=9.0)
+        assert pol.hedge_delay_s() == pol.default_delay_s  # cold
+        for s in (0.1, 0.2, 0.3):
+            pol.record(s)
+        assert abs(pol.hedge_delay_s() - 0.2) < 1e-9
+        clamped = HedgePolicy(percentile=50.0, min_samples=1,
+                              min_delay_s=0.5, max_delay_s=1.0)
+        clamped.record(0.01)
+        assert clamped.hedge_delay_s() == 0.5
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            HedgePolicy(percentile=0.0)
+        with pytest.raises(ValueError):
+            HedgePolicy(min_delay_s=2.0, max_delay_s=1.0)
+
+    def test_inject_straggler_schedule(self):
+        calls = []
+
+        def f(x):
+            calls.append(x)
+            return x
+
+        wrapped, audit = faults.inject_straggler(f, every=3, seconds=0.01)
+        outs = [wrapped(i) for i in range(6)]
+        assert audit.calls == 6 and audit.dispatches == 2
+        assert isinstance(outs[2], faults.DelayedReady)
+        assert isinstance(outs[5], faults.DelayedReady)
+        assert not isinstance(outs[0], faults.DelayedReady)
+
+
+# ---------------------------------------------------------------------------
+# Admission control (resilience/admission.py) — shed, never collapse
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionControl:
+    def test_sheds_when_queue_full(self):
+        ctrl = AdmissionController(max_concurrent=1, max_queue=0,
+                                   retry_after_s=0.5)
+        with ctrl.admit():
+            with pytest.raises(errors.RaftOverloadError) as ei:
+                with ctrl.admit():
+                    pass  # pragma: no cover
+            assert ei.value.retry_after_s == 0.5
+            assert not isinstance(ei.value, ValueError)  # loud, typed
+        st = ctrl.stats()
+        assert st.admitted == 1 and st.shed_queue == 1
+        assert st.shed == 1 and st.offered == 2
+        assert abs(st.shed_fraction - 0.5) < 1e-9
+
+    def test_queued_request_admitted_when_slot_frees(self):
+        ctrl = AdmissionController(max_concurrent=1, max_queue=2)
+        release = threading.Event()
+        admitted = threading.Event()
+
+        def holder():
+            with ctrl.admit():
+                admitted.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        admitted.wait(5.0)
+        got = []
+
+        def waiter():
+            with ctrl.admit(timeout_s=5.0):
+                got.append(1)
+
+        tw = threading.Thread(target=waiter)
+        tw.start()
+        time.sleep(0.05)
+        assert ctrl.queue_depth == 1 and not got
+        release.set()
+        tw.join(5.0)
+        th.join(5.0)
+        assert got == [1]
+        st = ctrl.stats()
+        assert st.admitted == 2 and st.shed == 0
+        assert st.peak_queue_depth == 1 and st.queue_depth == 0
+
+    def test_unbounded_deadline_waits_instead_of_overflowing(self):
+        """Deadline.unbounded()/after(None) through admit() must mean
+        'wait forever', not Condition.wait(inf) -> OverflowError."""
+        ctrl = AdmissionController(max_concurrent=1, max_queue=2)
+        release = threading.Event()
+        admitted = threading.Event()
+
+        def holder():
+            with ctrl.admit():
+                admitted.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        admitted.wait(5.0)
+        got = []
+
+        def waiter():
+            with ctrl.admit(deadline=Deadline.unbounded()):
+                got.append(1)
+
+        tw = threading.Thread(target=waiter)
+        tw.start()
+        time.sleep(0.05)
+        assert not got and ctrl.queue_depth == 1  # queued, not crashed
+        release.set()
+        tw.join(5.0)
+        th.join(5.0)
+        assert got == [1]
+
+    def test_timeout_while_queued_is_timeout_not_overload(self):
+        ctrl = AdmissionController(max_concurrent=1, max_queue=2)
+        release = threading.Event()
+
+        def holder():
+            with ctrl.admit():
+                release.wait(5.0)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        time.sleep(0.05)
+        with pytest.raises(errors.RaftTimeoutError):
+            with ctrl.admit(timeout_s=0.05):
+                pass  # pragma: no cover
+        release.set()
+        th.join(5.0)
+        assert ctrl.stats().timed_out == 1
+
+    def test_token_limiter_deterministic_clock(self):
+        t = [0.0]
+        ctrl = AdmissionController(max_concurrent=4, max_queue=4,
+                                   rate=2.0, burst=2, clock=lambda: t[0])
+        with ctrl.admit():
+            pass
+        with ctrl.admit():
+            pass
+        with pytest.raises(errors.RaftOverloadError) as ei:
+            with ctrl.admit():
+                pass  # pragma: no cover
+        assert 0.0 < ei.value.retry_after_s <= 0.5  # next token at rate 2/s
+        t[0] = 0.6                                  # refill > 1 token
+        with ctrl.admit():
+            pass
+        st = ctrl.stats()
+        assert st.shed_rate == 1 and st.admitted == 3
+
+    def test_retry_after_priced_from_measured_service(self):
+        ctrl = AdmissionController(max_concurrent=1, max_queue=0)
+        with ctrl.admit():
+            time.sleep(0.05)             # measurable service time
+        with ctrl.admit():               # in flight again
+            with pytest.raises(errors.RaftOverloadError) as ei:
+                with ctrl.admit():
+                    pass  # pragma: no cover
+        assert ei.value.retry_after_s is not None
+        assert ei.value.retry_after_s > 0.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AdmissionController(max_concurrent=0)
+        with pytest.raises(ValueError):
+            AdmissionController(max_queue=-1)
+        with pytest.raises(ValueError):
+            AdmissionController(rate=0.0)
+
+
+# ---------------------------------------------------------------------------
+# HealthReport → ShardHealth pipeline (apply_report)
+# ---------------------------------------------------------------------------
+
+
+class TestApplyReport:
+    def test_rank_attributed_failures_down_exactly_those(self):
+        h = ShardHealth(8)
+        report = HealthReport(probes={
+            "heartbeat@2": HealthProbe(ok=False, seconds=0.1, ranks=(2,)),
+            "heartbeat@5": HealthProbe(ok=False, seconds=0.1, ranks=(5,)),
+            "allreduce": HealthProbe(ok=True, seconds=0.1),
+        })
+        out = h.apply_report(report)
+        assert out is h                   # chainable: one-call pipeline
+        np.testing.assert_array_equal(h.mask(), [1, 1, 0, 1, 1, 0, 1, 1])
+
+    def test_unattributed_failure_downs_everything(self):
+        h = ShardHealth(4)
+        h.apply_report(HealthReport(probes={
+            "allgather": HealthProbe(ok=False, seconds=0.1),
+        }))
+        assert h.n_up == 0
+
+    def test_passing_report_marks_nothing(self):
+        h = ShardHealth(4)
+        h.mark_down(1)
+        h.apply_report(HealthReport(probes={
+            "allreduce": HealthProbe(ok=True, seconds=0.1),
+        }))
+        assert h.n_up == 3 and not h.is_up(1)  # no auto mark_up
+
+    def test_resolve_shard_mask_accepts_report(self):
+        from raft_tpu.resilience import resolve_shard_mask
+
+        report = HealthReport(probes={
+            "hb": HealthProbe(ok=False, seconds=0.0, ranks=(0, 3)),
+        })
+        np.testing.assert_array_equal(
+            resolve_shard_mask(report, 4), [0, 1, 1, 0]
+        )
 
 
 # ---------------------------------------------------------------------------
