@@ -248,6 +248,22 @@ def extra_kmeans():
     }
 
 
+def _adc_engine(index, nq, n_probes, *, qcap, refine_ratio):
+    """Which ADC engine the row's grouped/mnmg search resolves to —
+    stamped so the driver can verify the Pallas path was actually
+    active. Takes the row's REAL qcap and refine_ratio (the resolver
+    depends on both: the VMEM plan scales with qcap, and an unrefined
+    row always runs one-hot) so the stamp can never drift from the
+    measured configuration. One helper for all four stamped rows."""
+    from raft_tpu.spatial.ann.common import static_qcap
+    from raft_tpu.spatial.ann.ivf_pq import _resolve_adc_engine
+
+    return "pallas" if _resolve_adc_engine(
+        None, refine_ratio > 1.0, index.pq_dim, index.pq_bits,
+        static_qcap(qcap, nq, n_probes, index.centroids.shape[0]),
+    ) else "onehot"
+
+
 def extra_ivf_pq():
     """IVF-PQ refined search QPS with recall@10 vs an exact oracle.
 
@@ -343,6 +359,9 @@ def extra_ivf_pq():
         "unit": "QPS",
         "spread": st["spread"],
         "repeats": st["repeats"],
+        "escalations": st.get("escalations", 0),
+        "adc_engine": _adc_engine(pq, nq, n_probes, qcap="throughput",
+                                  refine_ratio=refine),
         "recall_at_10": round(recall_at_k(search(q)[1], true_np), 4),
         "build_s": round(build_s, 2),
         "build_warm_s": round(build_warm_s, 2),
@@ -422,13 +441,15 @@ def extra_ivf_pq_10m():
 
     from bench.common import chained_dispatch_stats
 
-    def chain_stats(f, qb):
+    def chain_stats(f, qb, escalate=1):
         float(jnp.sum(f(qb)[0]))  # compile + warm
         return chained_dispatch_stats(
-            lambda salt: qb * (1.0 + 1e-6 * salt), f, escalate=1,
+            lambda salt: qb * (1.0 + 1e-6 * salt), f, escalate=escalate,
         )
 
-    st = chain_stats(search, q)
+    # escalate=2: the r05 row shipped spread 0.268 — this row gets two
+    # chain-length growths, each re-laddered, and stamps how many it used
+    st = chain_stats(search, q, escalate=2)
     if st is None:
         return {"metric": "ivf_pq_10m", "error": "timing jitter-dominated"}
 
@@ -458,6 +479,9 @@ def extra_ivf_pq_10m():
         "unit": "QPS",
         "spread": st["spread"],
         "repeats": st["repeats"],
+        "escalations": st.get("escalations", 0),
+        "adc_engine": _adc_engine(pq, nq, n_probes, qcap=qcap,
+                                  refine_ratio=refine),
         "recall_at_10": round(hits / true_np.size, 4),
         "build_s": round(build_s, 2),
         "build_warm_s": round(build_warm_s, 2),
@@ -529,6 +553,9 @@ def extra_mnmg_ivf_pq():
         "unit": "QPS",
         "spread": st["spread"],
         "repeats": st["repeats"],
+        "escalations": st.get("escalations", 0),
+        "adc_engine": _adc_engine(idx, nq, 16, qcap="throughput",
+                                  refine_ratio=4.0),
         "recall_at_10": round(recall_at_k(search(q)[1], true_np), 4),
         "build_s": round(build_s, 2),
         "build_warm_s": round(build_warm_s, 2),
@@ -813,11 +840,17 @@ def _mnmg_shard_100m_impl(engine: str):
         "unit": "QPS",
         "spread": st["spread"],
         "repeats": st["repeats"],
+        "escalations": st.get("escalations", 0),
         "recall_at_10_vs_shard": round(rec, 4),
         "build_s": round(build_s, 2),
         "index_gb": round(index_gb / 1e9, 2),
         **fields,
     }
+    if engine == "pq":
+        # the driver's evidence that the Pallas path was active in the
+        # one-dispatch serving rows
+        out["adc_engine"] = _adc_engine(idx, nq, 16, qcap="throughput",
+                                         refine_ratio=8.0)
     out["n_probe_cents"] = n_gcents
     out["probe_flop_ratio"] = round(flops["ratio"], 2)
     out["probe_recall_vs_flat"] = round(probe_rec, 4)
@@ -1044,7 +1077,8 @@ def _stamp_vs_prev(row, prev):
 # r5's perf evidence never landed (BENCH_r05 parsed=null) because prose
 # note fields pushed the line over.
 _PRINT_KEYS = {
-    "metric", "value", "unit", "spread", "repeats", "error",
+    "metric", "value", "unit", "spread", "repeats", "escalations",
+    "error", "adc_engine",
     "recall_at_10", "recall_at_10_vs_shard", "build_s", "build_warm_s",
     "bf16_iters_per_s", "f32_highest_gflops", "vs_baseline",
     "brute_force_same_shape_qps", "measured_chip_qps", "qcap8_qps",
@@ -1063,7 +1097,8 @@ _PRINT_KEYS = {
 # r5's artifact landed parsed=null because prose pushed the line over,
 # and a trimmed-but-parsing line beats a complete-but-unparsed one
 _TRIM_ORDER = (
-    "repeats", "within_2x_warm", "probe_flop_ratio", "build_warm_s",
+    "repeats", "within_2x_warm", "escalations", "probe_flop_ratio",
+    "build_warm_s",
     "f32_highest_gflops", "bf16_iters_per_s", "measured_chip_qps",
     "brute_force_same_shape_qps", "qcap8_qps", "build_s",
 )
@@ -1132,7 +1167,7 @@ def _compact(row):
         if key not in _PRINT_KEYS and not key.startswith("vs_prev"):
             continue
         if isinstance(v, str) and key not in (
-            "metric", "unit", "error", "engine", "scenario"
+            "metric", "unit", "error", "engine", "scenario", "adc_engine"
         ):
             continue
         if isinstance(v, list) and v and isinstance(v[0], dict):

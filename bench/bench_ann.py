@@ -25,7 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from bench.common import bench_fn, chained_dispatch_ms
+from bench.common import bench_fn, chained_dispatch_ms, chained_dispatch_stats
 from raft_tpu.spatial.ann import (
     IVFFlatParams, ivf_flat_build, ivf_flat_search, ivf_flat_search_grouped,
     IVFPQParams, ivf_pq_build, ivf_pq_search, ivf_pq_search_grouped,
@@ -155,6 +155,76 @@ def main():
         else:
             rec["note"] = "quotient jitter-dominated at this scale"
         print(json.dumps(rec))
+
+    bench_pq_adc_kernel()
+
+
+def bench_pq_adc_kernel():
+    """The ADC scan-block microbench: XLA one-hot matmul + per-block
+    selection vs the Pallas sub-chunk-min kernel, at FIXED shapes (the
+    two engines' per-(list-block) scan work, isolated from probe/LUT
+    build/refine) — so the kernel speedup is tracked independently of
+    the end-to-end index QPS rows in bench.py. Spread-escalated via the
+    shared chained-dispatch harness; on a non-TPU backend the kernel
+    runs in interpret mode and the comparison is semantics-only."""
+    import functools
+
+    from raft_tpu.spatial.ann import pq_kernel
+
+    LB, L, M, K, Q, kk = 8, 2048, 12, 256, 48, 40
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(11)
+    luts = jax.device_put(
+        rng.standard_normal((LB, Q, M * K)).astype(np.float32)
+    )
+    codes = jax.device_put(
+        rng.integers(0, K, (LB, L, M)).astype(np.uint8)
+    )
+    codes_t = jnp.transpose(codes, (0, 2, 1))
+    bounds = jnp.tile(jnp.asarray([[0, L]], jnp.int32), (LB, 1))
+
+    @jax.jit
+    def onehot_block(lut_in):
+        # the legacy per-block scan: materialized one-hot, bf16
+        # contraction, per-(list, slot) approx selection — the work the
+        # kernel replaces (raft_tpu/spatial/ann/ivf_pq.py block_fn)
+        onehot = (
+            codes[..., None] == jnp.arange(K, dtype=jnp.uint8)
+        ).astype(jnp.bfloat16)
+        # the measured baseline IS the anti-pattern:
+        d2 = jax.lax.dot_general(  # jaxlint: disable=adc-gather
+            lut_in.astype(jnp.bfloat16),
+            onehot.reshape(LB, L, M * K),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        vals, _ = jax.lax.approx_min_k(d2, kk, recall_target=0.95)
+        return vals
+
+    l_tile = pq_kernel.plan_l_tile(M * K, Q)   # the tile the impl plans
+
+    @functools.partial(jax.jit, static_argnames=("interp",))
+    def kernel_block(lut_in, interp=interpret):
+        return pq_kernel.pq_adc_subchunk_min(
+            lut_in.astype(jnp.bfloat16), codes_t, bounds,
+            interpret=interp, l_tile=l_tile,
+        )
+
+    rec = {"name": f"ann/pq_adc_kernel/LB{LB}xL{L}xM{M}xK{K}q{Q}"}
+    for label, fn in (("onehot", onehot_block), ("pallas", kernel_block)):
+        jax.block_until_ready(fn(luts))
+        st = chained_dispatch_stats(
+            lambda salt: luts * (1.0 + 1e-6 * salt), fn, escalate=1,
+        )
+        if st is None:
+            rec[f"{label}_note"] = "jitter-dominated"
+            continue
+        rec[f"{label}_ms"] = round(st["ms"], 3)
+        rec[f"{label}_spread"] = st["spread"]
+        rec[f"{label}_escalations"] = st.get("escalations", 0)
+    if "onehot_ms" in rec and "pallas_ms" in rec:
+        rec["speedup"] = round(rec["onehot_ms"] / rec["pallas_ms"], 2)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

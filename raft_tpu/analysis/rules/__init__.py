@@ -22,6 +22,7 @@ class Rule:
 
 def _collect() -> List[Rule]:
     from raft_tpu.analysis.rules import (
+        adc_gather,
         api_compat,
         prng_discipline,
         recompile_hazard,
@@ -31,7 +32,7 @@ def _collect() -> List[Rule]:
 
     out: List[Rule] = []
     for mod in (api_compat, tracer_safety, recompile_hazard,
-                x64_hygiene, prng_discipline):
+                x64_hygiene, prng_discipline, adc_gather):
         out.extend(mod.RULES)
     return out
 

@@ -1,0 +1,210 @@
+"""Rule ``adc-gather``: per-candidate LUT gathers on the hot scan path.
+
+A 2^bits-entry lookup table gathered per candidate inside a jitted scan
+body is the ADC anti-pattern this codebase measured twice (docs/
+ivf_scale.md "ADC in VMEM"): XLA lowers it either to a per-element gather
+(random HBM reads, ~50x slower than a slab read at the 10M x 96 shape) or
+to a MATERIALIZED one-hot operand — (rows, M·2^bits) bf16 written to and
+re-read from HBM per scanned block, hundreds of GB per batch at the 10M
+bench geometry. Both spellings belong in the Pallas ADC engine
+(raft_tpu/spatial/ann/pq_kernel.py), where the expansion lives in VMEM
+and only sub-chunk minima reach HBM.
+
+Like ``recompile-hazard`` this is a *perf* lint, not a correctness one:
+flagged sites compute the right answer slowly. Two spellings are flagged,
+both only INSIDE traced bodies (the scan path — an eager/offline gather
+is fine):
+
+* ``jnp.take_along_axis(..., axis=N)`` with a literal ``N >= 2`` — a
+  trailing-axis table gather (the per-(query, probe) LUT axes come first,
+  the table axis last: the per-query ADC path's exact shape);
+* an ``einsum`` / ``dot_general`` / ``dot`` / ``matmul`` whose operand is
+  a one-hot built by comparing against ``arange``/``broadcasted_iota``
+  over a wide (>= 128 entries, or unresolvable) index set — directly, or
+  via a name assigned from such a compare (``.astype``/``.reshape``
+  chains are looked through).
+
+Suppress with ``# jaxlint: disable=adc-gather`` where the gather is cold
+or the table is small in practice; the remaining hot-path callers (the
+per-query ADC path kept for small-batch latency, and the grouped one-hot
+engine kept as the CPU/interpret fallback) are grandfathered in the
+baseline and burn down with the kernel rollout.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from raft_tpu.analysis.rules import Rule
+
+_GATHER_TAILS = {"take_along_axis"}
+_CONTRACT_TAILS = {"einsum", "dot_general", "dot", "matmul"}
+_IOTA_TAILS = {"arange", "iota", "broadcasted_iota"}
+
+# one-hot compares against index sets narrower than this are cheap
+# (probe masks, small codebooks) and stay unflagged when resolvable
+_WIDE = 128
+
+
+def _literal_axis(call: ast.Call) -> Optional[int]:
+    """The gather's axis when given as a literal (kwarg or 3rd arg),
+    including the unary-minus spelling ``axis=-1``."""
+    def lit(v):
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return v.value
+        if isinstance(v, ast.UnaryOp) and isinstance(v.op, ast.USub):
+            inner = lit(v.operand)
+            return None if inner is None else -inner
+        return None
+
+    for kw in call.keywords:
+        if kw.arg == "axis":
+            return lit(kw.value)
+    if len(call.args) >= 3:
+        return lit(call.args[2])
+    return None
+
+
+def _int_lit(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+def _iota_width(tail: str, call: ast.Call) -> Optional[int]:
+    """The literal width of an arange/iota call, when resolvable.
+
+    ``arange(stop)`` / ``arange(start, stop[, step])`` -> the span;
+    ``iota(dtype, size)`` -> size; ``broadcasted_iota(dtype, shape,
+    dimension)`` -> shape[dimension] when both are literal. None when
+    the width cannot be resolved (the caller flags conservatively)."""
+    if tail == "arange":
+        lits = [_int_lit(a) for a in call.args]
+        if len(call.args) == 1:
+            return lits[0]
+        if len(call.args) >= 2 and lits[0] is not None and \
+                lits[1] is not None:
+            return lits[1] - lits[0]
+        return None
+    if tail == "iota":                       # lax.iota(dtype, size)
+        return _int_lit(call.args[1]) if len(call.args) >= 2 else None
+    if tail == "broadcasted_iota":           # (dtype, shape, dimension)
+        if len(call.args) >= 3 and isinstance(call.args[1],
+                                              (ast.Tuple, ast.List)):
+            dim = _int_lit(call.args[2])
+            elts = call.args[1].elts
+            if dim is not None and 0 <= dim < len(elts):
+                return _int_lit(elts[dim])
+        return None
+    return None
+
+
+class AdcGatherRule(Rule):
+    name = "adc-gather"
+    description = (
+        "per-candidate LUT gather / materialized one-hot contraction "
+        "inside a traced body — route through the Pallas ADC engine"
+    )
+
+    # -- one-hot detection ---------------------------------------------------
+
+    def _wide_iota_compare(self, ctx, node: ast.AST) -> bool:
+        """Does this expression contain a compare against a wide (or
+        unresolvable-width) arange/iota call?"""
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Compare):
+                continue
+            for side in [n.left] + list(n.comparators):
+                for c in ast.walk(side):
+                    if not isinstance(c, ast.Call):
+                        continue
+                    d = ctx.facts.dotted(c.func)
+                    if d is None:
+                        continue
+                    tail = d.split(".")[-1]
+                    if tail not in _IOTA_TAILS:
+                        continue
+                    w = _iota_width(tail, c)
+                    if w is None or w >= _WIDE:
+                        return True
+        return False
+
+    def _onehot_names(self, ctx, fn) -> Set[str]:
+        """Names assigned (anywhere in the traced body) from a wide
+        iota-compare expression — one-hot matrices by construction."""
+        out: Set[str] = set()
+        for n in ctx.facts.traced_body_nodes(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                if self._wide_iota_compare(ctx, n.value):
+                    out.add(n.targets[0].id)
+        return out
+
+    def _operand_root(self, node: ast.AST) -> Optional[str]:
+        """Unwrap ``name.reshape(...).astype(...)``-style chains to the
+        root Name (how one-hot operands reach the dot in practice)."""
+        while True:
+            if isinstance(node, ast.Name):
+                return node.id
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                node = node.func.value
+                continue
+            if isinstance(node, ast.Attribute):
+                node = node.value
+                continue
+            return None
+
+    # -- checks --------------------------------------------------------------
+
+    def _check_gather(self, ctx, call: ast.Call) -> Iterator:
+        d = ctx.facts.dotted(call.func)
+        if d is None or d.split(".")[-1] not in _GATHER_TAILS:
+            return
+        axis = _literal_axis(call)
+        # axis >= 2 or the explicit trailing spelling axis=-1 (the most
+        # common way to write the LUT-table gather); axis 0/1 remaps are
+        # the benign selection shapes
+        if axis is None or (axis < 2 and axis != -1):
+            return
+        yield ctx.finding(
+            self.name, call,
+            f"trailing-axis LUT gather (take_along_axis axis={axis}) in a "
+            "traced body — per-candidate table lookups bound the ADC scan; "
+            "use the Pallas ADC engine (spatial/ann/pq_kernel) or suppress",
+        )
+
+    def _check_contraction(self, ctx, call: ast.Call,
+                           onehot: Set[str]) -> Iterator:
+        d = ctx.facts.dotted(call.func)
+        if d is None or d.split(".")[-1] not in _CONTRACT_TAILS:
+            return
+        for arg in call.args:
+            hit = self._wide_iota_compare(ctx, arg)
+            if not hit:
+                root = self._operand_root(arg)
+                hit = root is not None and root in onehot
+            if hit:
+                yield ctx.finding(
+                    self.name, call,
+                    "one-hot contraction over a wide index set in a traced "
+                    "body — XLA materializes the (rows, M*2^bits) one-hot "
+                    "operand through HBM; build it in VMEM instead "
+                    "(spatial/ann/pq_kernel) or suppress",
+                )
+                return
+
+    def check(self, ctx) -> Iterator:
+        seen: Set[int] = set()  # nested traced fns share body nodes
+        for fn in ctx.facts.traced:
+            onehot = self._onehot_names(ctx, fn)
+            for node in ctx.facts.traced_body_nodes(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                yield from self._check_gather(ctx, node)
+                yield from self._check_contraction(ctx, node, onehot)
+
+
+RULES = [AdcGatherRule()]

@@ -132,7 +132,8 @@ class MnmgIVFPQIndex:
                approx_recall_target: float = 0.95,
                donate_queries: bool = False,
                shard_mask=None, failover=None, overprobe: float = 2.0,
-               merge_ways: typing.Optional[int] = None) -> int:
+               merge_ways: typing.Optional[int] = None,
+               use_pallas: typing.Optional[bool] = None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches: one all-zeros batch runs through
         :func:`mnmg_ivf_pq_search` and is blocked on, so the first real
@@ -159,7 +160,7 @@ class MnmgIVFPQIndex:
             approx_recall_target=approx_recall_target,
             donate_queries=donate_queries, shard_mask=shard_mask,
             failover=failover, overprobe=overprobe,
-            merge_ways=merge_ways,
+            merge_ways=merge_ways, use_pallas=use_pallas,
         )
         jax.block_until_ready(out)
         return qc
@@ -1116,7 +1117,7 @@ def _cached_search(
     (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
      approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list,
      use_coarse, overprobe, merge_ways, replication,
-     replica_offset) = statics
+     replica_offset, use_pallas, pallas_interpret) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
     n_ranks = comms.size
@@ -1194,10 +1195,13 @@ def _cached_search(
         )
         # the UNCHANGED single-chip grouped kernel, probes pre-mapped to
         # shard-local list ids; sorted_ids are global so ids need no
-        # translation downstream
+        # translation downstream (use_pallas routes the shard-local ADC
+        # scan through the Pallas sub-chunk-min engine INSIDE the fused
+        # one-dispatch program — docs/ivf_scale.md "ADC in VMEM")
         vals, gids = _pq_grouped_impl(
             shard, qf, k, n_probes, qcap, list_block, refine_ratio,
             None, lp, exact_selection, approx_recall_target,
+            use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         )
         if degraded:
             # a down shard contributes +inf distances to the merge — its
@@ -1369,6 +1373,7 @@ def mnmg_ivf_pq_search(
     failover=None,
     overprobe: float = 2.0,
     merge_ways: typing.Optional[int] = None,
+    use_pallas: typing.Optional[bool] = None,
 ):
     """Distributed grouped ADC search over a list-sharded index.
 
@@ -1429,6 +1434,14 @@ def mnmg_ivf_pq_search(
     (static) pads the in-program cross-shard merge to a deployment's
     shard count — results are identical (absent peers contribute
     +inf/-1), the ``select_k`` runs at deployment width.
+
+    ``use_pallas`` (static) selects the shard-local ADC engine inside
+    the fused program — auto (``None``) engages the Pallas
+    sub-chunk-min kernel on TPU when refinement is active, exactly as
+    :func:`~raft_tpu.spatial.ann.ivf_pq.ivf_pq_search_grouped`
+    documents; the knob is a trace-time static, so like every other
+    static it never varies with health/failover state (zero retraces on
+    flips, trace-audited).
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -1451,6 +1464,12 @@ def mnmg_ivf_pq_search(
     )
     list_block = max(1, min(list_block, index.nl_pad))
     store_raw = index.vectors_sorted is not None
+    from raft_tpu.spatial.ann.ivf_pq import _resolve_adc_engine
+
+    use_pallas = _resolve_adc_engine(
+        use_pallas, store_raw and refine_ratio > 1.0,
+        index.pq_dim, index.pq_bits, qcap,
+    )
     statics = (
         k, n_probes, qcap, list_block, refine_ratio, exact_selection,
         approx_recall_target, index.pq_dim, index.pq_bits, index.n_pad,
@@ -1458,6 +1477,7 @@ def mnmg_ivf_pq_search(
         index.coarse is not None, float(overprobe),
         None if merge_ways is None else int(merge_ways),
         int(index.replication), int(index.replica_offset),
+        use_pallas, jax.default_backend() != "tpu",
     )
     degraded = shard_mask is not None
     errors.expects(

@@ -335,16 +335,28 @@ def select_candidates(storage: ListStorage, cand_pos, d2, k: int):
 def map_query_blocks(fn, queries, block_q: int):
     """Process queries in fixed-size blocks via ``lax.map`` so the
     (block, n_probes·max_list, d) candidate gather stays HBM-bounded
-    regardless of batch size. ``fn(q_block) -> (vals, ids)``."""
-    nq = queries.shape[0]
+    regardless of batch size. ``fn(q_block) -> (vals, ids)``.
+
+    ``queries`` may also be a TUPLE of arrays sharing the query leading
+    axis (e.g. queries + per-query candidate positions + validity masks
+    — the Pallas refine tail); each is zero-padded and blocked
+    identically and ``fn`` receives the tuple of blocks. Padded rows'
+    outputs are sliced away, so pad VALUES only need to be safe to
+    compute on, never correct."""
+    multi = isinstance(queries, tuple)
+    arrs = queries if multi else (queries,)
+    nq = arrs[0].shape[0]
     if block_q >= nq:
         return fn(queries)
     nb = -(-nq // block_q)
     pad = nb * block_q - nq
-    qp = jnp.pad(queries, ((0, pad),) + ((0, 0),) * (queries.ndim - 1))
-    vals, ids = jax.lax.map(
-        fn, qp.reshape(nb, block_q, *queries.shape[1:])
+    blocked = tuple(
+        jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1)).reshape(
+            nb, block_q, *a.shape[1:]
+        )
+        for a in arrs
     )
+    vals, ids = jax.lax.map(fn, blocked if multi else blocked[0])
     return (
         vals.reshape(nb * block_q, -1)[:nq],
         ids.reshape(nb * block_q, -1)[:nq],

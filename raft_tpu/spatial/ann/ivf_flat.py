@@ -213,7 +213,8 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
         invalid = (qids >= nq)[:, :, None] | (~in_list)[:, None, :]
         d2 = jnp.where(invalid, jnp.inf, d2)
         vals, sel = lax.top_k(-d2, k)                        # (LB, qcap, k)
-        memp = jnp.take_along_axis(
+        # k-wide selection remap, not a LUT gather:
+        memp = jnp.take_along_axis(  # jaxlint: disable=adc-gather
             jnp.broadcast_to(pos[:, None, :], d2.shape), sel, axis=2
         )
         return -vals, memp

@@ -441,6 +441,138 @@ def test_prng_suppression_honored():
     assert [f for f in out if f.rule == "prng-discipline"] == []
 
 
+# -- adc-gather --------------------------------------------------------------
+
+def test_adc_gather_flags_trailing_axis_lut_gather():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def scan(lut_t, codes):
+            return jnp.take_along_axis(lut_t, codes, axis=2)
+    """, rule="adc-gather")
+    assert len(out) == 1
+    assert "take_along_axis axis=2" in out[0].message
+
+
+def test_adc_gather_low_axis_and_host_gather_clean():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def remap(vals, order):
+            return jnp.take_along_axis(vals, order, axis=1)
+
+        def offline(lut_t, codes):   # not traced: offline build path
+            return jnp.take_along_axis(lut_t, codes, axis=2)
+    """, rule="adc-gather")
+    assert out == []
+
+
+def test_adc_gather_flags_onehot_contraction_via_name():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def adc(lut, codes, K):
+            onehot = (codes[..., None] == jnp.arange(K, dtype=jnp.uint8))
+            return jax.lax.dot_general(
+                lut, onehot.reshape(8, 512, -1).astype(jnp.bfloat16),
+                (((2,), (2,)), ((0,), (0,))),
+            )
+    """, rule="adc-gather")
+    assert len(out) == 1
+    assert "one-hot contraction" in out[0].message
+
+
+def test_adc_gather_two_arg_arange_and_broadcasted_iota():
+    """Width resolution must see through arange(start, stop) and
+    broadcasted_iota(dtype, shape, dimension) — both escaped the first
+    cut of the rule (review-caught)."""
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def adc(lut, codes):
+            onehot = (codes[..., None] == jnp.arange(0, 256))
+            return jnp.einsum("qk,lk->ql", lut, onehot.astype(jnp.float32))
+    """, rule="adc-gather")
+    assert len(out) == 1
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def adc(lut, codes):
+            onehot = (
+                codes[..., None]
+                == jax.lax.broadcasted_iota(jnp.int32, (8, 512, 256), 2)
+            ).astype(jnp.bfloat16)
+            return jax.lax.dot_general(
+                lut, onehot.reshape(8, 512, -1),
+                (((2,), (2,)), ((0,), (0,))),
+            )
+    """, rule="adc-gather")
+    assert len(out) == 1
+    # narrow 2-arg arange still clean
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def mask_dot(lut, codes):
+            onehot = (codes[..., None] == jnp.arange(0, 16))
+            return jnp.einsum("qk,lk->ql", lut, onehot.astype(jnp.float32))
+    """, rule="adc-gather")
+    assert out == []
+
+
+def test_adc_gather_narrow_onehot_clean():
+    # a probe-mask / small-codebook compare (literal width < 128) feeding
+    # a contraction is cheap and stays unflagged
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def mask_dot(lut, codes):
+            onehot = (codes[..., None] == jnp.arange(16)).astype(jnp.float32)
+            return jnp.einsum("qk,lk->ql", lut, onehot)
+    """, rule="adc-gather")
+    assert out == []
+
+
+def test_adc_gather_inline_onehot_operand_flagged():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def adc(lut, codes):
+            return jnp.einsum(
+                "qk,lk->ql", lut,
+                (codes[:, None] == jnp.arange(256)).astype(jnp.float32),
+            )
+    """, rule="adc-gather")
+    assert len(out) == 1
+
+
+def test_adc_gather_suppression_honored():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def remap(lut_t, codes):
+            return jnp.take_along_axis(lut_t, codes, axis=2)  # jaxlint: disable=adc-gather
+    """, rule="adc-gather")
+    assert out == []
+
+
 # -- engine: baseline, CLI, self-gate ---------------------------------------
 
 FIXTURE_BAD = textwrap.dedent("""

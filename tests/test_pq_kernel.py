@@ -1,0 +1,426 @@
+"""Pallas ADC engine (spatial/ann/pq_kernel) — tier-1 coverage.
+
+The kernel body runs under ``interpret=True`` on the CPU test platform
+(the same pattern tests/test_fused_knn.py uses), pinned bitwise against
+the op-for-op lax mirror and a float oracle; the grouped searches'
+``use_pallas=True`` path is then pinned against the one-hot engine:
+identical candidate multisets after exact refinement wherever the refine
+pools saturate (both engines then rescore every probed candidate in
+exact f32 — the value-exactness contract, mirroring the ``fused_knn``
+chunk-min value-exact / tie-order-may-differ contract), recall
+non-inferiority elsewhere (the sub-chunk pool is a superset by the
+cover argument), and MNMG parity inside the fused one-dispatch program
+with zero retraces across health flips.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.spatial.ann import IVFPQParams, ivf_pq_build
+from raft_tpu.spatial.ann import pq_kernel
+from raft_tpu.spatial.ann.ivf_pq import (
+    _resolve_adc_engine,
+    ivf_pq_search_grouped,
+)
+
+K_NN = 5
+
+
+def _rand_case(rng, lb, q, m, k_codes, l_pad):
+    luts = jnp.asarray(
+        rng.standard_normal((lb, q, m * k_codes)), jnp.bfloat16
+    )
+    codes = jnp.asarray(
+        rng.integers(0, k_codes, (lb, m, l_pad)), jnp.uint8
+    )
+    return luts, codes
+
+
+def _oracle_subchunk_min(luts, codes, bounds):
+    lut = np.asarray(luts, np.float32)
+    c = np.asarray(codes).astype(np.int64)
+    lb, q, mk = lut.shape
+    m, l_pad = c.shape[1], c.shape[2]
+    k_codes = mk // m
+    d2 = np.zeros((lb, q, l_pad), np.float32)
+    for b in range(lb):
+        for mm in range(m):
+            d2[b] += lut[b][:, mm * k_codes + c[b, mm]]
+    for b in range(lb):
+        lo, hi = int(bounds[b, 0]), int(bounds[b, 1])
+        mask = np.zeros(l_pad, bool)
+        mask[lo:hi] = True
+        d2[b] = np.where(mask[None, :], d2[b], pq_kernel.BIG)
+    sub = pq_kernel.SUBCHUNK
+    return d2.reshape(lb, q, l_pad // sub, sub).min(-1)
+
+
+@pytest.mark.parametrize(
+    "lb,q,m,k_codes,l_pad,l_tile",
+    [
+        (3, 32, 4, 16, 256, 128),    # two code tiles per list
+        (2, 16, 3, 256, 128, 128),   # full 8-bit codebook width
+        (1, 48, 5, 32, 512, 256),    # ragged M, wider tiles
+    ],
+)
+def test_kernel_matches_lax_mirror_bitwise(rng_np, lb, q, m, k_codes,
+                                           l_pad, l_tile):
+    """Interpret-mode kernel == lax mirror, bit for bit, masked rows
+    included — the 'lax fallback bit-compatible' acceptance pin."""
+    luts, codes = _rand_case(rng_np, lb, q, m, k_codes, l_pad)
+    bounds = jnp.asarray(
+        [[i, max(i, l_pad - 7 * i)] for i in range(lb)], jnp.int32
+    )
+    got = pq_kernel.pq_adc_subchunk_min(
+        luts, codes, bounds, interpret=True, l_tile=l_tile
+    )
+    ref = pq_kernel.pq_adc_subchunk_min_lax(luts, codes, bounds)
+    assert got.shape == (lb, q, l_pad // pq_kernel.SUBCHUNK)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    np.testing.assert_allclose(
+        np.asarray(got), _oracle_subchunk_min(luts, codes, bounds),
+        rtol=1e-5, atol=1e-4,
+    )
+
+
+def test_kernel_empty_and_full_ranges(rng_np):
+    """lo == hi (empty list) -> every sub-chunk min is BIG; full range
+    touches every row."""
+    luts, codes = _rand_case(rng_np, 2, 16, 4, 16, 256)
+    bounds = jnp.asarray([[5, 5], [0, 256]], jnp.int32)
+    got = np.asarray(pq_kernel.pq_adc_subchunk_min(
+        luts, codes, bounds, interpret=True, l_tile=128
+    ))
+    assert (got[0] == pq_kernel.BIG).all()
+    assert (got[1] < pq_kernel.BIG).all()
+
+
+def test_plan_and_supported_predicates():
+    assert pq_kernel.plan_l_tile(24 * 256, 48) is not None
+    assert pq_kernel.pq_adc_supported(24, 8, 48)
+    # every planned tile is lane-aligned, even from a non-128-multiple
+    # start and through budget-forced halvings (review regression)
+    for mk in (64, 6144, 96 * 256):
+        for start in (128, 384, 512):
+            lt = pq_kernel.plan_l_tile(mk, 64, l_tile=start)
+            if lt is not None:
+                assert lt % 128 == 0 and lt <= 512
+    # absurdly wide M*2^bits: one LUT block alone exceeds the budget
+    assert not pq_kernel.pq_adc_supported(4096, 8, 512)
+    with pytest.raises(ValueError):
+        pq_kernel.pq_adc_subchunk_min(
+            jnp.zeros((1, 8, 64), jnp.bfloat16),     # Q=8 not 16-aligned
+            jnp.zeros((1, 4, 128), jnp.uint8),
+            jnp.zeros((1, 2), jnp.int32), interpret=True,
+        )
+
+
+# -- grouped search: engine equivalence --------------------------------------
+
+@pytest.fixture(scope="module")
+def dataset():
+    # clustered data (8 tight blobs): with n_lists=48, k-means leaves
+    # EMPTY lists, so high-n_probes searches probe empty lists and
+    # padded tails (the masking edge cases)
+    from raft_tpu.random import make_blobs
+    from raft_tpu.random.rng import RngState
+
+    rng = np.random.default_rng(7)
+    n, d = 3000, 16
+    x, _ = make_blobs(n, d, n_clusters=8, cluster_std=0.5,
+                      state=RngState(3))
+    x = np.asarray(x, np.float32)
+    q = x[rng.integers(0, n, 64)] + 0.1 * rng.standard_normal(
+        (64, d)
+    ).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def pq_index(dataset):
+    x, _ = dataset
+    # n_lists > populated clusters on this data -> some lists are EMPTY,
+    # so probes hit empty lists and padded tails (the masking edge cases)
+    return ivf_pq_build(x, IVFPQParams(
+        n_lists=48, pq_dim=4, pq_bits=4, kmeans_n_iters=4,
+        kmeans_init="random",
+    ))
+
+
+@pytest.mark.parametrize("exact_selection", [True, False])
+@pytest.mark.parametrize("stream", [None, True])
+def test_saturated_pool_candidate_multiset_identical(
+    dataset, pq_index, exact_selection, stream
+):
+    """With refine_ratio * k >= every probed candidate, BOTH engines
+    exact-rescore the full probed pool — the returned (dists, ids) must
+    match exactly (same candidate multiset after refine)."""
+    x, q = dataset
+    p = 4
+    rr = float(p * pq_index.storage.max_list) / K_NN + 1.0
+    kw = dict(n_probes=p, refine_ratio=rr, qcap=64,
+              exact_selection=exact_selection, stream_partials=stream)
+    d0, i0 = ivf_pq_search_grouped(pq_index, q, K_NN, use_pallas=False,
+                                   **kw)
+    d1, i1 = ivf_pq_search_grouped(pq_index, q, K_NN, use_pallas=True,
+                                   **kw)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+def _with_emptied_lists(x, base, emptied):
+    """Rebuild ``base``'s storage with the rows of ``emptied`` lists
+    remapped into list 0 — those lists keep their centroids (so probes
+    still select them) but hold ZERO rows: the empty-probe edge case,
+    constructed deterministically."""
+    import dataclasses
+
+    from raft_tpu.spatial.ann.common import build_list_storage
+
+    n = base.storage.n
+    n_lists = base.centroids.shape[0]
+    sid = np.asarray(base.storage.sorted_ids)
+    sizes = np.asarray(base.storage.list_sizes)
+    labels = np.empty(n, np.int64)
+    labels[sid] = np.repeat(np.arange(n_lists), sizes)
+    labels = np.where(np.isin(labels, list(emptied)), 0, labels)
+    storage = build_list_storage(labels, n_lists)
+    codes_unsorted = np.empty((n, base.pq_dim), np.uint8)
+    codes_unsorted[sid] = np.asarray(base.codes_sorted)[:-1]
+    sid2 = np.asarray(storage.sorted_ids)
+    codes_sorted = jnp.concatenate([
+        jnp.asarray(codes_unsorted[sid2]),
+        jnp.zeros((1, base.pq_dim), jnp.uint8),
+    ])
+    vectors_sorted = jnp.concatenate([
+        jnp.asarray(x[sid2]), jnp.zeros((1, x.shape[1]), jnp.float32)
+    ])
+    return dataclasses.replace(
+        base, codes_sorted=codes_sorted, storage=storage,
+        vectors_sorted=vectors_sorted,
+    )
+
+
+def test_padded_lists_and_empty_probes_no_alien_candidates(
+    dataset, pq_index
+):
+    """Kernel-path results only ever contain rows of the probed lists:
+    sub-chunk windows overhang list tails into neighboring lists' slab
+    rows, and the per-row validity mask must drop them. Empty lists are
+    forced into the index (rows remapped away, centroids kept) so
+    probes hit genuinely empty lists."""
+    x, q = dataset
+    idx = _with_emptied_lists(x, pq_index, {1, 5, 9, 17})
+    storage = idx.storage
+    sizes = np.asarray(storage.list_sizes)
+    assert (sizes == 0).any(), "fixture must include empty lists"
+    p = 16
+    kw = dict(n_probes=p, refine_ratio=3.0, qcap=64,
+              exact_selection=True)
+    d1, i1 = ivf_pq_search_grouped(idx, q, K_NN, use_pallas=True, **kw)
+    # engine parity on the emptied index at a SATURATED refine pool
+    rr = float(p * storage.max_list) / K_NN + 1.0
+    kw_sat = dict(kw, refine_ratio=rr)
+    ds0, is0 = ivf_pq_search_grouped(idx, q, K_NN, use_pallas=False,
+                                     **kw_sat)
+    ds1, is1 = ivf_pq_search_grouped(idx, q, K_NN, use_pallas=True,
+                                     **kw_sat)
+    np.testing.assert_array_equal(np.asarray(ds0), np.asarray(ds1))
+    np.testing.assert_array_equal(np.asarray(is0), np.asarray(is1))
+    from raft_tpu.spatial.ann.common import coarse_probe
+
+    probes, _ = coarse_probe(
+        jnp.asarray(q, jnp.float32),
+        jnp.asarray(idx.centroids, jnp.float32), p,
+    )
+    probes = np.asarray(probes)
+    sid = np.asarray(storage.sorted_ids)
+    offs = np.asarray(storage.list_offsets)
+    ids = np.asarray(i1)
+    for qi in range(ids.shape[0]):
+        allowed = set()
+        for l in probes[qi]:
+            allowed.update(
+                sid[offs[l]:offs[l] + sizes[l]].tolist()
+            )
+        got = set(t for t in ids[qi].tolist() if t >= 0)
+        assert got <= allowed, f"query {qi} returned unprobed rows"
+
+
+def test_kernel_refine_pool_recall_non_inferior(dataset, pq_index):
+    """At a modest refine_ratio the sub-chunk pool is a SUPERSET of the
+    row pool (cover argument): kernel-path recall must not fall below
+    the one-hot path's."""
+    from tests.oracles import np_knn_ids
+
+    x, q = dataset
+    true = np_knn_ids(x, np.asarray(q), K_NN)
+
+    def rec(ids):
+        g = np.asarray(ids)
+        return sum(
+            len(set(a.tolist()) & set(b.tolist()))
+            for a, b in zip(g, true)
+        ) / true.size
+
+    kw = dict(n_probes=4, refine_ratio=2.0, qcap=64, exact_selection=True)
+    r_pal = rec(ivf_pq_search_grouped(pq_index, q, K_NN, use_pallas=True,
+                                      **kw)[1])
+    r_one = rec(ivf_pq_search_grouped(pq_index, q, K_NN, use_pallas=False,
+                                      **kw)[1])
+    assert r_pal >= r_one - 1e-9, (r_pal, r_one)
+
+
+def test_large_k_exceeding_subchunk_pool(dataset, pq_index):
+    """k > p * (l_pad/8) is legal whenever k <= p*max_list: the kernel
+    path must clamp its sub-chunk selection to the pool width instead of
+    asking top_k for more sub-chunks than exist (code-review regression:
+    the clamp order made c = k blow past the pool)."""
+    x, q = dataset
+    L = pq_index.storage.max_list
+    p = 2
+    # l_pad rounds L up to the tile, so the pool has p * l_pad / 8
+    # sub-chunks; pick k above that but within p * max_list
+    import raft_tpu.spatial.ann.pq_kernel as pk
+
+    l_tile = pk.plan_l_tile(4 * 16, 64)
+    l_pad = -(-L // l_tile) * l_tile
+    k = min(p * L, p * l_pad // pk.SUBCHUNK + 8)
+    assert k <= p * L
+    rr = float(p * L) / k + 1.0   # saturate BOTH engines' refine pools
+    d0, i0 = ivf_pq_search_grouped(
+        pq_index, q, k, n_probes=p, refine_ratio=rr, qcap=64,
+        exact_selection=True, use_pallas=False,
+    )
+    d1, i1 = ivf_pq_search_grouped(
+        pq_index, q, k, n_probes=p, refine_ratio=rr, qcap=64,
+        exact_selection=True, use_pallas=True,
+    )
+    assert d1.shape == d0.shape == (q.shape[0], k)
+    # at c = full pool both engines refine every probed candidate
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_use_pallas_requires_refine(dataset, pq_index):
+    x, q = dataset
+    with pytest.raises(Exception, match="refine"):
+        ivf_pq_search_grouped(
+            pq_index, q, K_NN, n_probes=4, refine_ratio=1.0, qcap=64,
+            use_pallas=True,
+        )
+
+
+def test_resolve_adc_engine_auto_off_tpu():
+    """Auto (None) never selects the kernel off-TPU — and never even
+    imports it (the JAX_PLATFORMS=cpu eager-import acceptance)."""
+    assert jax.default_backend() != "tpu"
+    assert _resolve_adc_engine(None, True, 24, 8, 48) is False
+    assert _resolve_adc_engine(True, True, 24, 8, 48) is True
+    assert _resolve_adc_engine(False, True, 24, 8, 48) is False
+
+
+def test_cpu_default_never_imports_kernel_module():
+    """A fresh JAX_PLATFORMS=cpu process running a default grouped
+    search must not import (let alone compile) the Pallas kernel
+    module."""
+    prog = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import numpy as np\n"
+        "from raft_tpu.spatial.ann import IVFPQParams, ivf_pq_build\n"
+        "from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search_grouped\n"
+        "rng = np.random.default_rng(0)\n"
+        "x = rng.standard_normal((400, 8)).astype(np.float32)\n"
+        "pq = ivf_pq_build(x, IVFPQParams(n_lists=8, pq_dim=2,\n"
+        "    pq_bits=4, kmeans_n_iters=2, kmeans_init='random'))\n"
+        "ivf_pq_search_grouped(pq, x[:8], 3, n_probes=2, qcap=8)\n"
+        "assert 'raft_tpu.spatial.ann.pq_kernel' not in sys.modules, \\\n"
+        "    'CPU default search imported the TPU kernel module'\n"
+        "print('OK')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK" in out.stdout
+
+
+# -- MNMG: the fused one-dispatch program ------------------------------------
+
+@pytest.fixture(scope="module")
+def comms8():
+    from raft_tpu.comms import build_comms
+
+    return build_comms(jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def sharded_index(dataset, comms8):
+    from raft_tpu.comms import mnmg_ivf_pq_build
+
+    x, _ = dataset
+    return mnmg_ivf_pq_build(comms8, x, IVFPQParams(
+        n_lists=32, pq_dim=4, pq_bits=4, kmeans_n_iters=4,
+        kmeans_init="random",
+    ))
+
+
+def test_mnmg_fused_program_engine_parity(dataset, comms8, sharded_index):
+    """The Pallas path ACTIVE inside the MNMG fused one-dispatch program:
+    saturated-pool results identical to the one-hot engine's."""
+    from raft_tpu.comms import mnmg_ivf_pq_search
+
+    _, q = dataset
+    p = 4
+    rr = float(p * sharded_index.max_list) / K_NN + 1.0
+    kw = dict(n_probes=p, refine_ratio=rr, qcap=q.shape[0])
+    d0, i0 = mnmg_ivf_pq_search(comms8, sharded_index, q, K_NN,
+                                use_pallas=False, **kw)
+    d1, i1 = mnmg_ivf_pq_search(comms8, sharded_index, q, K_NN,
+                                use_pallas=True, **kw)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+def test_mnmg_pallas_health_flip_zero_retrace(
+    dataset, comms8, sharded_index, monkeypatch
+):
+    """The acceptance trace-audit with the kernel engaged: use_pallas is
+    a trace-time static, alive/failover stay runtime inputs — health
+    flips must reuse the ONE compiled fused program (zero retraces)."""
+    from raft_tpu.comms import mnmg_ivf as mod
+
+    _, q = dataset
+    created = []
+    orig = mod._cached_search
+
+    def recording(*a, **k):
+        fn = orig(*a, **k)
+        created.append(fn)
+        return fn
+
+    monkeypatch.setattr(mod, "_cached_search", recording)
+    kw = dict(n_probes=4, refine_ratio=3.0, qcap=q.shape[0],
+              use_pallas=True)
+    m_up = np.ones(8, np.int32)
+    m_one = m_up.copy()
+    m_one[2] = 0
+    mod.mnmg_ivf_pq_search(comms8, sharded_index, q, K_NN,
+                           shard_mask=m_up, **kw)
+    fn = created[0]
+    size0 = fn._cache_size()
+    for mask in (m_one, m_up):
+        res = mod.mnmg_ivf_pq_search(comms8, sharded_index, q, K_NN,
+                                     shard_mask=mask, **kw)
+    assert all(f is fn for f in created), \
+        "health flips must reuse the cached program object"
+    assert fn._cache_size() == size0, \
+        "health flips must not retrace the compiled kernel program"
+    assert float(jnp.min(res.coverage)) == 1.0

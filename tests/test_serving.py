@@ -428,11 +428,13 @@ def test_serving_resilience_rows_tiny_config():
 
 
 def test_round6_bench_line_parses(benchtop_module=None):
-    """ISSUE 5 satellite: the round-6 artifact shape — the r5 extras
-    plus this round's serving resilience rows — must print as a line
-    that json.loads-round-trips under the 1800-char driver cap (r5
-    shipped parsed=null; the _fit_line self-check is asserted HERE, not
-    left for the driver to discover)."""
+    """ISSUE 5 satellite (extended for the r6 PQ-kernel round): the
+    current artifact shape — the r5 extras, the serving resilience
+    rows, plus this round's ``escalations``/``adc_engine`` stamps —
+    must print as a line that json.loads-round-trips under the
+    1800-char driver cap (r5 shipped parsed=null; the _fit_line
+    self-check is asserted HERE, not left for the driver to
+    discover)."""
     import importlib.util
     import json
 
@@ -460,7 +462,8 @@ def test_round6_bench_line_parses(benchtop_module=None):
     ]
     extras = [
         {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
-         "spread": 0.05, "repeats": 7, "recall_at_10": 0.95,
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
          "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
          "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
          "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
