@@ -1089,6 +1089,11 @@ _PRINT_KEYS = {
     # the serving resilience rows (bench/bench_serving.py): straggler
     # p99 with/without hedging and the 2x-overload shed behavior
     "scenario", "p99_ms", "hedged_p99_ms", "shed_rate",
+    # the mutation tier's mixed read/write row (ISSUE 7,
+    # docs/mutation.md): search QPS under concurrent ingest vs the
+    # frozen engine, sustained ingest rate, mutation visibility
+    "mixed_search_qps", "frozen_qps", "qps_ratio_vs_frozen",
+    "ingest_qps", "upsert_visible_ms", "delete_masked_ms",
 }
 
 
@@ -1099,6 +1104,7 @@ _PRINT_KEYS = {
 _TRIM_ORDER = (
     "repeats", "within_2x_warm", "escalations", "probe_flop_ratio",
     "build_warm_s",
+    "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "f32_highest_gflops", "bf16_iters_per_s", "measured_chip_qps",
     "brute_force_same_shape_qps", "qcap8_qps", "build_s",
 )

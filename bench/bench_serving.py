@@ -31,6 +31,12 @@ Two resilience rows ride on the IVF-Flat engine (docs/serving.md
   requests stays bounded at ~(max_queue+1) service times and the
   excess load is shed with ``RaftOverloadError`` (``shed_rate``)
   instead of collapsing the queue.
+
+A third ``mixed_ingest`` row measures the mutation tier
+(docs/mutation.md): search QPS under concurrent streaming ingest next
+to the frozen-index QPS (``qps_ratio_vs_frozen`` — acceptance >= ~0.8
+at equal recall), sustained ``ingest_qps``, and the upsert->visible /
+delete->masked latencies (:func:`mixed_ingest_row`).
 """
 
 from __future__ import annotations
@@ -200,12 +206,150 @@ def overload_row(run, qb, *, over_factor: float = 2.0,
     return row
 
 
+def mixed_ingest_row(idx, qb, *, k: int = 10, n_probes: int = 16,
+                     ingest_batch: int = 256, delta_cap: int = 64,
+                     chain=(2, 8), escalate: int = 1) -> dict:
+    """The sustained mixed read/write row (ISSUE 7 acceptance): search
+    QPS while EVERY dispatch also ingests an ``ingest_batch``-row upsert
+    into the mutable tier, next to the frozen-index QPS of the same
+    engine/config, plus the two mutation latencies a production caller
+    cares about — upsert→visible and delete→masked (each measured
+    through the real ack + serve path).
+
+    Methodology: the three throughput numbers are chained-dispatch
+    quotients (bench/common.py — ``escalations`` stamped like every QPS
+    row). The mixed chain drives the ASYNC ingest path (the jitted
+    upsert program, state threaded functionally, no per-batch ack sync)
+    interleaved with the mutable serving search; ``frozen_qps`` is the
+    plain frozen engine on the identical config, so
+    ``qps_ratio_vs_frozen`` prices the whole mutation tier (tombstone
+    fold + delta scan + concurrent ingest). Delta capacity may saturate
+    over a long measured chain — rejected upserts run the identical
+    program, so the quotient is unaffected (the visibility metrics use
+    their own fresh ids)."""
+    import dataclasses
+
+    from bench.common import chained_dispatch_stats
+    from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
+    from raft_tpu.spatial.ann.mutation import (
+        _upsert_impl, delete as mut_delete, mutable_search,
+        mutable_warmup, upsert as mut_upsert, wrap_mutable,
+    )
+
+    nq, d = qb.shape
+    mw = wrap_mutable(idx, delta_cap=delta_cap)
+    qcap = mutable_warmup(mw, nq, k=k, n_probes=n_probes,
+                          ingest_batch=ingest_batch)
+    row = {
+        "engine": "ivf_flat", "scenario": "mixed_ingest", "nq": int(nq),
+        "ingest_batch": int(ingest_batch), "qcap": int(qcap),
+    }
+
+    # frozen-index reference: the plain engine at the identical config
+    idx.warmup(nq, k=k, n_probes=n_probes, qcap=qcap)
+
+    def run_frozen(qq):
+        return ivf_flat_search_grouped(idx, qq, k, n_probes=n_probes,
+                                       qcap=qcap)
+
+    jax.block_until_ready(run_frozen(qb))
+    st_f = chained_dispatch_stats(
+        lambda s: qb * (1.0 + 1e-6 * s), run_frozen,
+        n1=chain[0], n2=chain[1], escalate=escalate,
+    )
+
+    # ingest-only: the jitted upsert program, state threaded through a
+    # cell (functional updates, no ack sync — the async serving path)
+    ing_ids = jnp.arange(10_000_000, 10_000_000 + ingest_batch,
+                         dtype=jnp.int32)
+    cell = {"delta": mw.delta, "rm": mw.row_mask}
+
+    def run_ingest(vb):
+        nd, nrm, acc, _, _ = _upsert_impl(
+            idx.centroids, cell["delta"], cell["rm"], mw.id_to_pos,
+            vb, ing_ids,
+        )
+        cell["delta"], cell["rm"] = nd, nrm
+        return acc.astype(jnp.float32)
+
+    vb0 = jnp.tile(qb, (-(-ingest_batch // nq), 1))[:ingest_batch]
+    jax.block_until_ready(run_ingest(vb0))
+    st_i = chained_dispatch_stats(
+        lambda s: vb0 * (1.0 + 1e-6 * s), run_ingest,
+        n1=chain[0], n2=chain[1], escalate=escalate,
+    )
+
+    # mixed: every dispatch ingests one batch AND serves one search
+    cell["delta"], cell["rm"] = mw.delta, mw.row_mask
+
+    def run_mixed(qq):
+        vb = jnp.tile(qq, (-(-ingest_batch // nq), 1))[:ingest_batch]
+        nd, nrm, _, _, _ = _upsert_impl(
+            idx.centroids, cell["delta"], cell["rm"], mw.id_to_pos,
+            vb, ing_ids,
+        )
+        cell["delta"], cell["rm"] = nd, nrm
+        cur = dataclasses.replace(mw, delta=nd, row_mask=nrm)
+        return mutable_search(cur, qq, k, n_probes=n_probes, qcap=qcap)
+
+    jax.block_until_ready(run_mixed(qb))
+    st_m = chained_dispatch_stats(
+        lambda s: qb * (1.0 + 1e-6 * s), run_mixed,
+        n1=chain[0], n2=chain[1], escalate=escalate,
+    )
+
+    if st_f is not None:
+        row["frozen_qps"] = round(nq / (st_f["ms"] / 1e3), 1)
+    if st_i is not None:
+        row["ingest_qps"] = round(ingest_batch / (st_i["ms"] / 1e3), 1)
+    if st_m is not None:
+        row["mixed_search_qps"] = round(nq / (st_m["ms"] / 1e3), 1)
+        row["spread"] = st_m["spread"]
+        row["repeats"] = st_m["repeats"]
+        row["escalations"] = st_m.get("escalations", 0)
+        if st_f is not None:
+            row["qps_ratio_vs_frozen"] = round(
+                row["mixed_search_qps"] / row["frozen_qps"], 3
+            )
+    if st_f is None and st_m is None:
+        row["error"] = "jitter-dominated"
+        return row
+
+    # upsert→visible: ack one fresh-id batch whose row 0 equals the
+    # probe query, then serve it back — measured on WARMED programs (the
+    # qcap resolved above; the 1-row probe shape pre-compiled below), so
+    # the number is the serving-path ack+serve latency, not a compile
+    mw2 = wrap_mutable(idx, delta_cap=delta_cap)
+    qc1 = mutable_warmup(mw2, 1, k=k, n_probes=n_probes)
+    mut_delete(mw2, np.array([-1], np.int32))   # warm the B=1 delete
+    probe = qb[:1] * 1.001
+    vis_batch = jnp.concatenate([probe, vb0[1:]])
+    vis_ids = np.arange(20_000_000, 20_000_000 + ingest_batch,
+                        dtype=np.int32)
+    t0 = time.perf_counter()
+    mw3, acc = mut_upsert(mw2, vis_batch, vis_ids)
+    iv = mutable_search(mw3, probe, k, n_probes=n_probes, qcap=qc1)[1]
+    jax.block_until_ready(iv)
+    vis_ms = (time.perf_counter() - t0) * 1e3
+    if bool(acc[0]) and int(np.asarray(iv)[0, 0]) == int(vis_ids[0]):
+        row["upsert_visible_ms"] = round(vis_ms, 3)
+    # delete→masked: tombstone it and serve — the row must be gone
+    t0 = time.perf_counter()
+    mw4, found = mut_delete(mw3, vis_ids[:1])
+    iv2 = mutable_search(mw4, probe, k, n_probes=n_probes, qcap=qc1)[1]
+    jax.block_until_ready(iv2)
+    del_ms = (time.perf_counter() - t0) * 1e3
+    if bool(found[0]) and int(vis_ids[0]) not in np.asarray(iv2)[0].tolist():
+        row["delete_masked_ms"] = round(del_ms, 3)
+    return row
+
+
 def serving_latency_rows(
     n: int = 500_000, d: int = 96, k: int = 10, n_probes: int = 16,
     n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
                                            "ivf_pq"),
     chain=(4, 32), escalate: int = 2,
-    hedged: bool = True, overload: bool = True,
+    hedged: bool = True, overload: bool = True, mixed: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
@@ -340,6 +484,24 @@ def serving_latency_rows(
         except Exception as e:                       # noqa: BLE001
             rows.append({
                 "engine": "ivf_flat", "scenario": "resilience",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+
+    # the mutation tier's mixed read/write row (ISSUE 7): sustained
+    # ingest QPS alongside search QPS, upsert→visible / delete→masked
+    if mixed and "ivf_flat" in engines:
+        try:
+            nq_m = min(128, max(nqs))
+            rows.append(mixed_ingest_row(
+                get_index("ivf_flat"), qall[:nq_m], k=k,
+                n_probes=n_probes,
+                ingest_batch=min(256, max(8, nq_m * 2)),
+                chain=(chain[0], max(chain[0] + 1, chain[1] // 4)),
+                escalate=escalate,
+            ))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "ivf_flat", "scenario": "mixed_ingest",
                 "error": f"{type(e).__name__}: {e}"[:160],
             })
     return {

@@ -24,6 +24,7 @@ def _collect() -> List[Rule]:
     from raft_tpu.analysis.rules import (
         adc_gather,
         api_compat,
+        mutation_retrace,
         prng_discipline,
         recompile_hazard,
         tracer_safety,
@@ -32,7 +33,8 @@ def _collect() -> List[Rule]:
 
     out: List[Rule] = []
     for mod in (api_compat, tracer_safety, recompile_hazard,
-                x64_hygiene, prng_discipline, adc_gather):
+                x64_hygiene, prng_discipline, adc_gather,
+                mutation_retrace):
         out.extend(mod.RULES)
     return out
 

@@ -1,0 +1,153 @@
+"""Rule ``mutation-retrace``: mutation-tier state read as a Python
+static inside a traced body.
+
+The whole zero-retrace contract of the mutation subsystem
+(raft_tpu/spatial/ann/mutation.py, docs/mutation.md) rests on delta
+occupancy and tombstones being RUNTIME values: an upsert fills a slot, a
+delete flips a mask entry, and the compiled serving program never
+changes. The one way to break that silently is to read one of those
+values back into Python inside a traced body — ``int(delta_counts[l])``,
+``if tombstones.any():``, ``range(live_count)`` — which either raises a
+``TracerConversionError`` at trace time or, worse, constant-folds a
+snapshot of the mutation state into the compiled program so every
+mutation forces a retrace (the recompile hazard specific to this
+subsystem; its general siblings live in ``recompile-hazard``).
+
+Flagged INSIDE traced bodies only (host-side compaction/bookkeeping
+reads these freely), for names that look like mutation state
+(``delta_count(s)``, ``delta_fill``, ``tombstone(s)``, ``row_mask``,
+``live_count``, ``dead_count``, ``n_dead``, ``n_tombstones`` — dotted
+accesses like ``delta.counts`` normalize to ``delta_counts``):
+
+* ``int()`` / ``bool()`` / ``float()`` coercion of such a value;
+* ``.item()`` / ``.tolist()`` on it;
+* a Python ``if`` / ``while`` test referencing it (``is None`` /
+  ``is not None`` presence tests are exempt — argument presence is
+  pytree structure, a legitimate trace-time static);
+* ``range()`` over it (a data-dependent trip count).
+
+Suppress with ``# jaxlint: disable=mutation-retrace`` where the value
+is genuinely a static (e.g. a capacity constant that happens to share
+the naming).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from raft_tpu.analysis.rules import Rule
+
+_PAT = re.compile(
+    r"(^|_)(delta_counts?|delta_fill|tombstones?|row_mask|live_count|"
+    r"dead_count|n_dead|n_tombstones)($|_)"
+)
+_COERCIONS = {"int", "bool", "float"}
+_HOST_METHODS = {"item", "tolist"}
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """The dotted name of a Name/Attribute chain with dots normalized to
+    underscores (``delta.counts`` -> ``delta_counts``), or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return "_".join(reversed(parts))
+    return None
+
+
+def _mutation_name(node: ast.AST) -> Optional[str]:
+    """The first mutation-state name referenced anywhere in ``node``
+    (subscripts like ``delta_counts[l]`` are looked through)."""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            d = _dotted_name(n)
+            if d is not None and _PAT.search(d):
+                return d
+    return None
+
+
+class MutationRetraceRule(Rule):
+    name = "mutation-retrace"
+    description = (
+        "delta-occupancy / tombstone value read as a Python static "
+        "inside a traced body — every mutation would retrace"
+    )
+
+    def _check_call(self, ctx, call: ast.Call) -> Iterator:
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id in (
+            _COERCIONS | {"range"}
+        ):
+            if not call.args:
+                return
+            hit = _mutation_name(call.args[0])
+            if hit is None:
+                return
+            what = (
+                f"range({hit}) — a data-dependent trip count"
+                if fn.id == "range"
+                else f"{fn.id}({hit}) — host coercion of a runtime value"
+            )
+            yield ctx.finding(
+                self.name, call,
+                f"{what} inside a traced body: mutation state must stay "
+                "a runtime input (upserts/tombstone flips would retrace "
+                "the serving program); hoist to the host path or "
+                "suppress if genuinely static",
+            )
+        elif isinstance(fn, ast.Attribute) and fn.attr in _HOST_METHODS:
+            hit = _mutation_name(fn.value)
+            if hit is not None:
+                yield ctx.finding(
+                    self.name, call,
+                    f"{hit}.{fn.attr}() inside a traced body — host "
+                    "readback of mutation state constant-folds a "
+                    "snapshot into the compiled program (retrace per "
+                    "mutation); keep it a runtime input",
+                )
+
+    def _is_presence_test(self, node: ast.AST) -> bool:
+        """``x is None`` / ``x is not None`` (possibly under ``not``):
+        an ARGUMENT-PRESENCE check — pytree structure, a legitimate
+        trace-time static — not a value read."""
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return self._is_presence_test(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return all(self._is_presence_test(v) for v in node.values)
+        return isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        )
+
+    def _check_branch(self, ctx, node) -> Iterator:
+        if self._is_presence_test(node.test):
+            return
+        hit = _mutation_name(node.test)
+        if hit is not None:
+            kind = "if" if isinstance(node, ast.If) else "while"
+            yield ctx.finding(
+                self.name, node.test,
+                f"Python `{kind}` on {hit} inside a traced body — "
+                "control flow on mutation state traces one branch as a "
+                "constant (retrace per mutation); use jnp.where / "
+                "lax.cond on the runtime value instead",
+            )
+
+    def check(self, ctx) -> Iterator:
+        seen: set = set()          # nested traced fns share body nodes
+        for fn in ctx.facts.traced:
+            for node in ctx.facts.traced_body_nodes(fn):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                if isinstance(node, ast.Call):
+                    yield from self._check_call(ctx, node)
+                elif isinstance(node, (ast.If, ast.While)):
+                    yield from self._check_branch(ctx, node)
+
+
+RULES = [MutationRetraceRule()]

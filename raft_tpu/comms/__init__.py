@@ -39,6 +39,15 @@ from raft_tpu.comms.mnmg_ivf_flat import (
     mnmg_ivf_flat_build_distributed,
     mnmg_ivf_flat_search,
 )
+from raft_tpu.comms.mnmg_mutation import (
+    MnmgMutableIndex,
+    MnmgMutationState,
+    mnmg_delete,
+    mnmg_mutable_search,
+    mnmg_upsert,
+    resync_rank,
+    wrap_mnmg_mutable,
+)
 from raft_tpu.comms.ring import ring_knn, ring_pairwise_distance
 
 __all__ = [
@@ -69,6 +78,13 @@ __all__ = [
     "replicate_index",
     "reshard_index",
     "shard_rows",
+    "MnmgMutableIndex",
+    "MnmgMutationState",
+    "wrap_mnmg_mutable",
+    "mnmg_upsert",
+    "mnmg_delete",
+    "mnmg_mutable_search",
+    "resync_rank",
     "ring_knn",
     "ring_pairwise_distance",
 ]

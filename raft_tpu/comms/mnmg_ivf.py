@@ -133,7 +133,8 @@ class MnmgIVFPQIndex:
                donate_queries: bool = False,
                shard_mask=None, failover=None, overprobe: float = 2.0,
                merge_ways: typing.Optional[int] = None,
-               use_pallas: typing.Optional[bool] = None) -> int:
+               use_pallas: typing.Optional[bool] = None,
+               mutation=None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches: one all-zeros batch runs through
         :func:`mnmg_ivf_pq_search` and is blocked on, so the first real
@@ -161,6 +162,7 @@ class MnmgIVFPQIndex:
             donate_queries=donate_queries, shard_mask=shard_mask,
             failover=failover, overprobe=overprobe,
             merge_ways=merge_ways, use_pallas=use_pallas,
+            mutation=mutation,
         )
         jax.block_until_ready(out)
         return qc
@@ -1077,10 +1079,44 @@ def recover_rank(comms: Comms, index, path, rank: int):
     return dataclasses.replace(index, **kw)
 
 
+def _merge_local_delta(qf, vals, gids, dvl, dil, k, rank, nl_pad,
+                       replication, replica_offset, n_ranks, alive,
+                       route):
+    """Shard-local tail of the MUTATION-tier fused programs (both
+    engines): exactly-score this rank's delta segments against the
+    replicated queries and fold the top-k into the rank's (nq, k)
+    contribution BEFORE the cross-shard merge.
+
+    ``dvl``/``dil`` are the rank's flattened (nl_pad*cap, d)/(nl_pad*cap,)
+    delta slabs. Replica discipline mirrors the main scan's serve rule:
+    a delta entry is scanned only by the rank whose slab SEGMENT is
+    currently serving its logical shard (healthy/all-zeros route →
+    segment 0, i.e. primaries), so replicated delta copies never
+    duplicate in the merge and a failover flip moves delta serving to
+    the replica with the same runtime ``route`` input — tombstones and
+    delta rows behave identically on primary and replica copies
+    (docs/mutation.md "Sharded mutation"). The scan/fold itself is the
+    single-chip tier's ``delta_merge_topk`` — one implementation."""
+    from raft_tpu.spatial.ann.mutation import delta_merge_topk
+
+    DL = dil.shape[0]
+    cap = DL // nl_pad
+    nlp_base = nl_pad // replication
+    seg = (jnp.arange(DL, dtype=jnp.int32) // cap) // nlp_base
+    if route is not None:
+        shard_of = (rank - seg * replica_offset) % n_ranks
+        serve = (route[shard_of] == seg) & (alive[rank] > 0)
+    else:
+        serve = seg == 0
+    return delta_merge_topk(
+        qf, vals, gids, dvl, dil, serve & (dil >= 0), k
+    )
+
+
 @functools.lru_cache(maxsize=32)
 def _cached_search(
     mesh: jax.sharding.Mesh, axis: str, store_raw: bool, statics: tuple,
-    donate: bool = False, degraded: bool = False,
+    donate: bool = False, degraded: bool = False, mutation: bool = False,
 ):
     """Compile one shard_map search program per (mesh, static-config).
 
@@ -1123,13 +1159,19 @@ def _cached_search(
     n_ranks = comms.size
 
     def body(*opnds):
+        (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
+         loffs, lszs, q, sup_c, mem_i, cpad) = opnds[:14]
+        rest = list(opnds[14:])
+        alive = route = None
         if degraded:
-            (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
-             loffs, lszs, q, sup_c, mem_i, cpad, alive, route) = opnds
-        else:
-            (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
-             loffs, lszs, q, sup_c, mem_i, cpad) = opnds
-            alive = route = None
+            alive, route = rest[0], rest[1]
+            rest = rest[2:]
+        rm_s = dv_s = di_s = None
+        if mutation:
+            # mutation-tier runtime inputs (comms/mnmg_mutation.py):
+            # per-rank tombstone row mask + flattened delta segments —
+            # upsert/delete flips change VALUES only, never the program
+            rm_s, dv_s, di_s = rest
         # sharded slabs arrive as (1, ...) blocks — drop the mesh axis
         lcents, codes_s, sids = lcents[0], codes_s[0], sids[0]
         loffs, lszs = loffs[0], lszs[0]
@@ -1202,7 +1244,13 @@ def _cached_search(
             shard, qf, k, n_probes, qcap, list_block, refine_ratio,
             None, lp, exact_selection, approx_recall_target,
             use_pallas=use_pallas, pallas_interpret=pallas_interpret,
+            row_mask=rm_s[0] if mutation else None,
         )
+        if mutation:
+            vals, gids = _merge_local_delta(
+                qf, vals, gids, dv_s[0], di_s[0], k, rank, nl_pad,
+                replication, replica_offset, n_ranks, alive, route,
+            )
         if degraded:
             # a down shard contributes +inf distances to the merge — its
             # candidates can never displace a live shard's
@@ -1239,11 +1287,14 @@ def _cached_search(
     if degraded:
         in_specs = in_specs + (P(None), P(None))     # alive, route
         out_specs = (rep2, rep2, P(None), P(None))
+    if mutation:
+        # row_mask, delta_vecs, delta_ids — per-rank mutation slabs
+        in_specs = in_specs + (sharded2, sharded, sharded2)
     sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
     # queries are positional argument 10 (the coarse arrays and, when
-    # present, the alive mask + failover route follow them); donation
-    # frees/aliases the batch buffer for the outputs (index slabs are
-    # never donated)
+    # present, the alive mask + failover route and the mutation slabs
+    # follow them); donation frees/aliases the batch buffer for the
+    # outputs (index slabs are never donated)
     return jax.jit(sm, donate_argnums=(10,) if donate else ())
 
 
@@ -1259,6 +1310,34 @@ def _coarse_probe_operands(index, d):
         jnp.zeros((1, 1), jnp.int32),
         jnp.zeros((1, 1, d), jnp.float32),
     )
+
+
+def _mutation_operands(mutation, index, n_ranks: int):
+    """Normalize a search's ``mutation=`` argument (None, an
+    ``MnmgMutationState``, or an ``MnmgMutableIndex`` wrapper) to the
+    three per-rank runtime operands of the mutation-tier program —
+    ``(row_mask (P, n_pad+1), delta_vecs (P, nl_pad*cap, d),
+    delta_ids (P, nl_pad*cap))`` — or None. Shapes are validated against
+    the index layout so a state built for a different geometry cannot
+    splice rows into the wrong slots."""
+    if mutation is None:
+        return None
+    state = getattr(mutation, "state", mutation)
+    rm, dv, di = state.row_mask, state.delta_vecs, state.delta_ids
+    errors.expects(
+        tuple(rm.shape) == (n_ranks, index.n_pad + 1),
+        "mutation state row_mask shape %s does not match the index "
+        "layout (%s)", tuple(rm.shape), (n_ranks, index.n_pad + 1),
+    )
+    errors.expects(
+        dv.ndim == 3 and dv.shape[0] == n_ranks
+        and dv.shape[1] % index.nl_pad == 0
+        and tuple(di.shape) == tuple(dv.shape[:2]),
+        "mutation state delta slabs (%s / %s) do not match the index "
+        "layout (P=%d, nl_pad=%d)", tuple(dv.shape), tuple(di.shape),
+        n_ranks, index.nl_pad,
+    )
+    return rm, dv, di
 
 
 def _check_probe_args(index, nl_g, overprobe, merge_ways, n_ranks):
@@ -1374,6 +1453,7 @@ def mnmg_ivf_pq_search(
     overprobe: float = 2.0,
     merge_ways: typing.Optional[int] = None,
     use_pallas: typing.Optional[bool] = None,
+    mutation=None,
 ):
     """Distributed grouped ADC search over a list-sharded index.
 
@@ -1442,6 +1522,16 @@ def mnmg_ivf_pq_search(
     documents; the knob is a trace-time static, so like every other
     static it never varies with health/failover state (zero retraces on
     flips, trace-audited).
+
+    ``mutation`` engages the MUTATION-tier variant
+    (:mod:`raft_tpu.comms.mnmg_mutation`): pass an
+    :class:`~raft_tpu.comms.mnmg_mutation.MnmgMutationState` (or the
+    :class:`~raft_tpu.comms.mnmg_mutation.MnmgMutableIndex` wrapper) and
+    the fused program folds the per-rank tombstone row mask into the
+    shard-local scan and merges an exact scan of the rank's delta
+    segments before the cross-shard merge. All mutation inputs are
+    RUNTIME values — upserts, tombstone flips, and health/failover flips
+    share one compiled program (docs/mutation.md "Sharded mutation").
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -1485,9 +1575,10 @@ def mnmg_ivf_pq_search(
         "failover= requires shard_mask= (the resilient serving variant "
         "carries the routing input)",
     )
+    mut_args = _mutation_operands(mutation, index, comms.size)
     fn = _cached_search(
         comms.mesh, comms.axis, store_raw, statics, donate_queries,
-        degraded,
+        degraded, mut_args is not None,
     )
     vecs = (
         index.vectors_sorted if store_raw
@@ -1502,13 +1593,15 @@ def mnmg_ivf_pq_search(
         index.list_offsets, index.list_sizes, q, sup_c, mem_i, cpad,
     )
     if not degraded:
-        return fn(*args)
+        return fn(*args, *(mut_args or ()))
     alive = resolve_shard_mask(shard_mask, comms.size)
     route = resolve_route(
         failover, comms.size, int(index.replication),
         int(index.replica_offset),
     )
-    md, mi, cov, rv = fn(*args, jnp.asarray(alive), jnp.asarray(route))
+    md, mi, cov, rv = fn(
+        *args, jnp.asarray(alive), jnp.asarray(route), *(mut_args or ())
+    )
     return PartialSearchResult(
         distances=md, ids=mi, coverage=cov, row_valid=rv
     )

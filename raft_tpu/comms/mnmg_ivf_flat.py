@@ -121,7 +121,8 @@ class MnmgIVFFlatIndex:
                n_probes: int = 8, qcap=None, list_block: int = 32,
                donate_queries: bool = False, shard_mask=None,
                failover=None, overprobe: float = 2.0,
-               merge_ways: typing.Optional[int] = None) -> int:
+               merge_ways: typing.Optional[int] = None,
+               mutation=None) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through
         :func:`mnmg_ivf_flat_search` — the Flat sibling of
@@ -142,6 +143,7 @@ class MnmgIVFFlatIndex:
             list_block=list_block, donate_queries=donate_queries,
             shard_mask=shard_mask, failover=failover,
             overprobe=overprobe, merge_ways=merge_ways,
+            mutation=mutation,
         )
         jax.block_until_ready(out)
         return qc
@@ -277,7 +279,7 @@ def mnmg_ivf_flat_build_distributed(
 @functools.lru_cache(maxsize=32)
 def _cached_search(
     mesh: jax.sharding.Mesh, axis: str, statics: tuple,
-    donate: bool = False, degraded: bool = False,
+    donate: bool = False, degraded: bool = False, mutation: bool = False,
 ):
     """Compile one shard_map search program per (mesh, static-config);
     keyed on value-hashable (mesh, axis), not the Comms identity.
@@ -299,13 +301,17 @@ def _cached_search(
     n_ranks = comms.size
 
     def body(*opnds):
+        (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
+         q, sup_c, mem_i, cpad) = opnds[:12]
+        rest = list(opnds[12:])
+        alive = route = None
         if degraded:
-            (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
-             q, sup_c, mem_i, cpad, alive, route) = opnds
-        else:
-            (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
-             q, sup_c, mem_i, cpad) = opnds
-            alive = route = None
+            alive, route = rest[0], rest[1]
+            rest = rest[2:]
+        rm_s = dv_s = di_s = None
+        if mutation:
+            # mutation-tier runtime inputs (comms/mnmg_mutation.py)
+            rm_s, dv_s, di_s = rest
         lcents, vecs, sids = lcents[0], vecs_s[0], sids[0]
         loffs, lszs = loffs[0], lszs[0]
         rank = lax.axis_index(ax.axis)
@@ -366,7 +372,15 @@ def _cached_search(
         # pre-mapped to shard-local list ids; sorted_ids are global
         vals, gids = _grouped_impl(
             shard, qf, k, n_probes, qcap, list_block, probes=lp,
+            row_mask=rm_s[0] if mutation else None,
         )
+        if mutation:
+            from raft_tpu.comms.mnmg_ivf import _merge_local_delta
+
+            vals, gids = _merge_local_delta(
+                qf, vals, gids, dv_s[0], di_s[0], k, rank, nl_pad,
+                replication, replica_offset, n_ranks, alive, route,
+            )
         if degraded:
             # a down shard contributes +inf distances to the merge
             vals = jnp.where(alive[rank] > 0, vals, jnp.inf)
@@ -396,10 +410,13 @@ def _cached_search(
     if degraded:
         in_specs = in_specs + (P(None), P(None))     # alive, route
         out_specs = (rep2, rep2, P(None), P(None))
+    if mutation:
+        # row_mask, delta_vecs, delta_ids — per-rank mutation slabs
+        in_specs = in_specs + (sharded2, sharded3, sharded2)
     sm = comms.shard_map(body, in_specs=in_specs, out_specs=out_specs)
     # queries are positional argument 8; the coarse arrays and, when
-    # present, the alive mask + failover route follow them (donation:
-    # serving mode)
+    # present, the alive mask + failover route and the mutation slabs
+    # follow them (donation: serving mode)
     return jax.jit(sm, donate_argnums=(8,) if donate else ())
 
 
@@ -413,6 +430,7 @@ def mnmg_ivf_flat_search(
     failover=None,
     overprobe: float = 2.0,
     merge_ways: typing.Optional[int] = None,
+    mutation=None,
 ):
     """Distributed grouped EXACT search over a list-sharded IVF-Flat
     index. Returns (distances, GLOBAL row ids), both (nq, k) replicated
@@ -453,6 +471,12 @@ def mnmg_ivf_flat_search(
     coarse quantizer, and deployment-width padding of the in-program
     cross-shard merge (identical results; absent peers contribute
     +inf/-1).
+
+    ``mutation`` engages the mutation-tier variant exactly as in the PQ
+    engine (:func:`raft_tpu.comms.mnmg_ivf.mnmg_ivf_pq_search`): pass
+    an :class:`~raft_tpu.comms.mnmg_mutation.MnmgMutationState` (or its
+    wrapper) and tombstones + delta segments fold into the fused
+    program as runtime inputs (docs/mutation.md "Sharded mutation").
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -489,8 +513,12 @@ def mnmg_ivf_flat_search(
         "failover= requires shard_mask= (the resilient serving variant "
         "carries the routing input)",
     )
+    from raft_tpu.comms.mnmg_ivf import _mutation_operands
+
+    mut_args = _mutation_operands(mutation, index, comms.size)
     fn = _cached_search(
-        comms.mesh, comms.axis, statics, donate_queries, degraded
+        comms.mesh, comms.axis, statics, donate_queries, degraded,
+        mut_args is not None,
     )
     sup_c, mem_i, cpad = _coarse_probe_operands(
         index, index.centroids.shape[1]
@@ -501,7 +529,7 @@ def mnmg_ivf_flat_search(
         index.list_sizes, q, sup_c, mem_i, cpad,
     )
     if not degraded:
-        vals, ids = fn(*args)
+        vals, ids = fn(*args, *(mut_args or ()))
         if index.metric == "l2":
             vals = jnp.sqrt(jnp.maximum(vals, 0.0))
         return vals, ids
@@ -510,7 +538,9 @@ def mnmg_ivf_flat_search(
         failover, comms.size, int(index.replication),
         int(index.replica_offset),
     )
-    md, mi, cov, rv = fn(*args, jnp.asarray(alive), jnp.asarray(route))
+    md, mi, cov, rv = fn(
+        *args, jnp.asarray(alive), jnp.asarray(route), *(mut_args or ())
+    )
     if index.metric == "l2":
         # sqrt after the merge, exactly as the healthy path; +inf slots
         # (down shards, invalid rows) stay +inf

@@ -1,0 +1,363 @@
+"""Replica-routed online mutation for the sharded IVF engines — the
+MNMG tier of the mutation subsystem (single-chip tier:
+:mod:`raft_tpu.spatial.ann.mutation`; docs/mutation.md "Sharded
+mutation").
+
+Write path (control plane, host-routed like the builds): an upsert is
+assigned to its nearest global centroid, and the row is appended to the
+owning shard's delta segment on EVERY holder rank of that shard
+(:class:`~raft_tpu.resilience.ReplicaPlacement` — the same striped
+layout the slabs replicate under). A write is ACKNOWLEDGED only when
+every LIVE holder recorded it, so an acknowledged upsert survives
+``fail_rank`` of any single rank mid-ingest: the surviving replica keeps
+serving it (through the same runtime ``failover`` route the main slabs
+use), and :func:`resync_rank` copies the recovered rank's mutation slabs
+back from a live replica peer — the mutation-tier sibling of
+``recover_rank``'s checkpoint splice. Deletes tombstone the row on ALL
+holder ranks (dead ones included — their state is resynced anyway), so
+a delete routed while a rank is down masks the row on the serving
+replica too (bit-identical results vs the healthy mesh, tested).
+
+Read path: both fused searches take ``mutation=`` and fold the per-rank
+tombstone mask + an exact scan of the rank's delta segments into the ONE
+serving dispatch. Every mutation input is a RUNTIME value — upserts,
+tombstone flips, and health/failover flips share one compiled program
+(zero retraces, trace-audited with the Pallas ADC engine engaged).
+
+Compaction at MNMG scale is the rebuild/reshard path: drain the deltas
+through ``mnmg_*_build_distributed`` (or restore + re-place a compacted
+checkpoint); the delta capacity budget should cover the ingest expected
+between rebuilds (docs/mutation.md "Capacity tuning").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu import compat, errors
+from raft_tpu.cluster.kmeans import kmeans_predict
+from raft_tpu.comms.comms import Comms
+from raft_tpu.resilience.degraded import resolve_shard_mask
+from raft_tpu.resilience.replica import ReplicaPlacement
+
+__all__ = [
+    "MnmgMutationState",
+    "MnmgMutableIndex",
+    "mnmg_delete",
+    "mnmg_mutable_search",
+    "mnmg_upsert",
+    "resync_rank",
+    "wrap_mnmg_mutable",
+]
+
+
+@compat.register_dataclass
+@dataclasses.dataclass
+class MnmgMutationState:
+    """Per-rank mutation slabs, stacked over the mesh axis like every
+    other sharded field. ``delta_vecs``/``delta_ids`` flatten each
+    rank's ``(nl_pad, cap)`` delta segments to one ``(nl_pad * cap,)``
+    scan axis (``nl_pad`` already contains the R replica segments, so
+    replica copies of a shard's delta rows live at the same local-list
+    offsets as its main slabs); ``-1`` ids are empty or tombstoned
+    slots. ``row_mask`` is the per-rank live mask over main-slab
+    positions."""
+
+    row_mask: jax.Array      # (P, n_pad + 1) int8
+    delta_vecs: jax.Array    # (P, nl_pad * cap, d) f32
+    delta_ids: jax.Array     # (P, nl_pad * cap) int32
+    delta_counts: jax.Array  # (P, nl_pad) int32
+    cap: int = dataclasses.field(metadata=dict(static=True))
+
+
+@dataclasses.dataclass
+class MnmgMutableIndex:
+    """A sharded index plus its mutation state — NOT a pytree (carries
+    the host-side id→slab-location map the write path routes deletes
+    through). Pass it (or ``.state``) as the searches' ``mutation=``."""
+
+    index: typing.Any
+    state: MnmgMutationState
+
+    def __post_init__(self):
+        self._id_loc: typing.Optional[dict] = None
+
+    @property
+    def placement(self) -> ReplicaPlacement:
+        return ReplicaPlacement.of_index(self.index)
+
+    def id_locations(self) -> dict:
+        """id → [(rank, slab position), ...] over every replica copy of
+        the MAIN slabs (delta rows are matched by value instead). Built
+        lazily host-side; the main slabs never change between rebuilds,
+        so the map is stable across upserts/deletes."""
+        if self._id_loc is None:
+            sids = np.asarray(self.index.sorted_ids)
+            offs = np.asarray(self.index.list_offsets)
+            loc: dict = {}
+            for r in range(sids.shape[0]):
+                nrows = int(offs[r, -1])
+                for p, i in enumerate(sids[r, :nrows].tolist()):
+                    loc.setdefault(int(i), []).append((r, p))
+            self._id_loc = loc
+        return self._id_loc
+
+
+def _with_state(mindex: MnmgMutableIndex,
+                state: MnmgMutationState) -> MnmgMutableIndex:
+    out = MnmgMutableIndex(index=mindex.index, state=state)
+    out._id_loc = mindex._id_loc            # main slabs unchanged
+    return out
+
+
+def _place_state(comms: Comms, rm, dv, di, dc, cap) -> MnmgMutationState:
+    def put(a, ndim):
+        return jax.device_put(
+            jnp.asarray(a),
+            NamedSharding(comms.mesh,
+                          P(comms.axis, *([None] * (ndim - 1)))),
+        )
+
+    return MnmgMutationState(
+        row_mask=put(rm, 2), delta_vecs=put(dv, 3), delta_ids=put(di, 2),
+        delta_counts=put(dc, 2), cap=int(cap),
+    )
+
+
+def wrap_mnmg_mutable(comms: Comms, index, *,
+                      delta_cap: int = 16) -> MnmgMutableIndex:
+    """Wrap a sharded (PQ or Flat) index for online mutation: empty
+    per-rank delta slabs of static ``delta_cap`` rows per local list
+    plus an all-live row mask, placed onto the mesh with the slab
+    sharding. The index's own arrays are aliased, not copied."""
+    errors.expects(delta_cap >= 1, "delta_cap=%d < 1", delta_cap)
+    Pn = int(index.sorted_ids.shape[0])
+    errors.expects(
+        Pn == comms.size,
+        "wrap_mnmg_mutable: index has %d ranks, mesh %d", Pn, comms.size,
+    )
+    d = index.centroids.shape[1]
+    nlp = int(index.nl_pad)
+    state = _place_state(
+        comms,
+        np.ones((Pn, index.n_pad + 1), np.int8),
+        np.zeros((Pn, nlp * delta_cap, d), np.float32),
+        np.full((Pn, nlp * delta_cap), -1, np.int32),
+        np.zeros((Pn, nlp), np.int32),
+        delta_cap,
+    )
+    return MnmgMutableIndex(index=index, state=state)
+
+
+def _pull_state(state: MnmgMutationState):
+    return (
+        np.asarray(state.row_mask).copy(),
+        np.asarray(state.delta_vecs).copy(),
+        np.asarray(state.delta_ids).copy(),
+        np.asarray(state.delta_counts).copy(),
+    )
+
+
+def mnmg_upsert(comms: Comms, mindex: MnmgMutableIndex, vectors, ids, *,
+                alive=None):
+    """Route an upsert batch to each row's owning shard AND its replica
+    holders. Returns ``(new_mindex, accepted)`` — ``accepted[i]`` is the
+    ACK: the row is recorded on EVERY live holder of its shard (and at
+    least one holder is live), so any single subsequent rank failure
+    cannot lose it (the chaos contract, tests/test_mutation.py). Rows
+    routed to a full segment, to an unowned (owner=-1) centroid, or with
+    a negative id are rejected.
+
+    Host-routed like the distributed builds (the write path is the
+    control plane; batch writes accordingly — the serving read path
+    never host-syncs). ``alive``: anything ``resolve_shard_mask``
+    accepts; writes skip dead holders — :func:`resync_rank` brings a
+    recovered rank's slabs back from a live peer."""
+    index = mindex.index
+    vecs = np.asarray(jnp.asarray(vectors), np.float32)
+    ids_np = np.asarray(ids, np.int32)
+    errors.expects(
+        vecs.ndim == 2 and vecs.shape[0] == ids_np.shape[0],
+        "mnmg_upsert: vectors (%s) and ids (%s) disagree",
+        tuple(vecs.shape), tuple(ids_np.shape),
+    )
+    B = ids_np.shape[0]
+    Pn = comms.size
+    alive_np = np.asarray(resolve_shard_mask(
+        True if alive is None else alive, Pn
+    ))
+    placement = mindex.placement
+    R, off = placement.replication, placement.offset
+    nlp_base = int(index.nl_pad) // R
+    cap = mindex.state.cap
+    owner = np.asarray(index.owner)
+    local_id = np.asarray(index.local_id)
+    lbl = np.asarray(kmeans_predict(
+        jnp.asarray(vecs), jnp.asarray(index.centroids, jnp.float32)
+    )).astype(np.int64)
+    own = owner[lbl]
+    lid = local_id[lbl]
+    valid = (ids_np >= 0) & (own >= 0)
+
+    rm, dv, di, dc = _pull_state(mindex.state)
+    loc = mindex.id_locations()
+
+    # 1) PLAN acceptance first (no state touched): ack requires a slot
+    # on EVERY live holder and at least one live holder — a rejected
+    # row must be a strict no-op (its previous copy keeps serving)
+    accepted = valid.copy()
+    seen_live = np.zeros(B, bool)
+    slot_of = np.full((B, R), -1, np.int64)
+    fill: dict = {}                   # (rank, local list) -> next slot
+    for i in range(B):
+        if not accepted[i]:
+            continue
+        for j in range(R):
+            rj = (int(own[i]) + j * off) % Pn
+            if not alive_np[rj]:
+                continue
+            seen_live[i] = True
+            ll = j * nlp_base + int(lid[i])
+            base = fill.get((rj, ll), int(dc[rj, ll]))
+            if base >= cap:
+                accepted[i] = False
+                break
+            slot_of[i, j] = base
+            fill[(rj, ll)] = base + 1
+    accepted &= seen_live
+
+    # 2) tombstone previous MAIN copies of ACCEPTED ids (all holders)
+    for i in np.nonzero(accepted)[0]:
+        for r, p in loc.get(int(ids_np[i]), ()):
+            rm[r, p] = 0
+    # 3) supersede previous DELTA copies of ACCEPTED ids (all ranks)
+    di[np.isin(di, ids_np[accepted])] = -1
+
+    # 4) append to every live holder
+    for i in np.nonzero(accepted)[0]:
+        for j in range(R):
+            s = int(slot_of[i, j])
+            if s < 0:
+                continue
+            rj = (int(own[i]) + j * off) % Pn
+            ll = j * nlp_base + int(lid[i])
+            dv[rj, ll * cap + s] = vecs[i]
+            di[rj, ll * cap + s] = ids_np[i]
+            dc[rj, ll] += 1
+    return (
+        _with_state(mindex, _place_state(comms, rm, dv, di, dc, cap)),
+        accepted,
+    )
+
+
+def mnmg_delete(comms: Comms, mindex: MnmgMutableIndex, ids):
+    """Tombstone-delete ids on EVERY replica copy — main-slab mask flips
+    on all holder ranks plus delta matches on all ranks, so the delete
+    is visible no matter which copy the failover route serves (the
+    tombstone-vs-replica contract, tests/test_mutation.py). Returns
+    ``(new_mindex, found)``."""
+    index = mindex.index
+    ids_np = np.asarray(ids, np.int32)
+    errors.expects(
+        ids_np.ndim == 1, "mnmg_delete: expected a 1-d id batch, got %s",
+        tuple(ids_np.shape),
+    )
+    rm, dv, di, dc = _pull_state(mindex.state)
+    loc = mindex.id_locations()
+    found = np.zeros(ids_np.shape[0], bool)
+    for i, gid in enumerate(ids_np.tolist()):
+        if gid < 0:
+            continue
+        for r, p in loc.get(int(gid), ()):
+            if rm[r, p]:
+                found[i] = True
+            rm[r, p] = 0
+    dmatch = np.isin(di, ids_np[ids_np >= 0])
+    if dmatch.any():
+        found |= np.isin(ids_np, np.unique(di[dmatch]))
+        di[dmatch] = -1
+    return (
+        _with_state(
+            mindex, _place_state(comms, rm, dv, di, dc, mindex.state.cap)
+        ),
+        found,
+    )
+
+
+def resync_rank(comms: Comms, mindex: MnmgMutableIndex,
+                rank: int) -> MnmgMutableIndex:
+    """Restore one recovered rank's MUTATION slabs from a live replica
+    peer — the mutation-tier companion of
+    :func:`raft_tpu.comms.mnmg_ivf.recover_rank` (which splices the
+    MAIN slabs from a CRC-verified checkpoint): for every slab segment
+    the rank holds, copy the logical shard's delta rows, counts, and
+    per-list tombstone mask from another holder of that shard. After
+    ``recover_rank`` + ``resync_rank`` the healed rank is byte-
+    equivalent to its peers and the failover route can flip back to
+    primaries with no acknowledged write lost (the chaos contract)."""
+    index = mindex.index
+    Pn = comms.size
+    errors.expects(
+        0 <= rank < Pn, "resync_rank: rank %d out of range [0, %d)",
+        rank, Pn,
+    )
+    placement = mindex.placement
+    R, off = placement.replication, placement.offset
+    errors.expects(
+        R > 1,
+        "resync_rank: index is unreplicated (R=1) — a lost rank's "
+        "mutation state has no surviving copy; restore from a delta "
+        "checkpoint instead (docs/mutation.md)",
+    )
+    nlp = int(index.nl_pad)
+    nlp_base = nlp // R
+    cap = mindex.state.cap
+    rm, dv, di, dc = _pull_state(mindex.state)
+    loffs = np.asarray(index.list_offsets)
+    lszs = np.asarray(index.list_sizes)
+    for j, s in enumerate(placement.segments(rank)):
+        holders = placement.holders(s)
+        donor = next(
+            (int(r) for r in holders if int(r) != rank), None
+        )
+        errors.expects(
+            donor is not None,
+            "resync_rank: shard %d has no other holder", s,
+        )
+        j2 = holders.index(donor)
+        for lid_ in range(nlp_base):
+            ll, ll2 = j * nlp_base + lid_, j2 * nlp_base + lid_
+            dv[rank, ll * cap:(ll + 1) * cap] = \
+                dv[donor, ll2 * cap:(ll2 + 1) * cap]
+            di[rank, ll * cap:(ll + 1) * cap] = \
+                di[donor, ll2 * cap:(ll2 + 1) * cap]
+            dc[rank, ll] = dc[donor, ll2]
+            sz = int(lszs[rank, ll])
+            o_d, o_s = int(loffs[rank, ll]), int(loffs[donor, ll2])
+            rm[rank, o_d:o_d + sz] = rm[donor, o_s:o_s + sz]
+    return _with_state(mindex, _place_state(comms, rm, dv, di, dc, cap))
+
+
+def mnmg_mutable_search(comms: Comms, mindex: MnmgMutableIndex, queries,
+                        k: int, **kw):
+    """Serve a search over a mutable sharded index: the engine's fused
+    one-dispatch program with ``mutation=`` engaged (tombstones folded
+    into the shard-local scan, delta segments exactly scanned and merged
+    in-program). All other knobs — ``shard_mask``/``failover``,
+    ``qcap``, ``merge_ways``, ``use_pallas`` — pass through unchanged."""
+    from raft_tpu.comms.mnmg_ivf import MnmgIVFPQIndex, mnmg_ivf_pq_search
+    from raft_tpu.comms.mnmg_ivf_flat import mnmg_ivf_flat_search
+
+    if isinstance(mindex.index, MnmgIVFPQIndex):
+        return mnmg_ivf_pq_search(
+            comms, mindex.index, queries, k, mutation=mindex.state, **kw
+        )
+    return mnmg_ivf_flat_search(
+        comms, mindex.index, queries, k, mutation=mindex.state, **kw
+    )

@@ -29,6 +29,22 @@ from raft_tpu.spatial.ann.approx import (
     approx_knn_build_index, approx_knn_search,
 )
 from raft_tpu.spatial.ann.serialize import save_index, load_index
+from raft_tpu.spatial.ann.mutation import (
+    BackgroundCompactor,
+    CompactionPolicy,
+    DeltaStore,
+    MutableIndex,
+    apply_delta_checkpoint,
+    compact,
+    compaction_stats,
+    delete,
+    mutable_search,
+    mutable_warmup,
+    probe_overlap,
+    save_delta_checkpoint,
+    upsert,
+    wrap_mutable,
+)
 from raft_tpu.spatial.ann.ball_cover import (
     BallCoverIndex,
     rbc_build_index,
@@ -46,4 +62,8 @@ __all__ = [
     "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",
     "save_index", "load_index",
     "approx_knn_build_index", "approx_knn_search",
+    "MutableIndex", "DeltaStore", "wrap_mutable", "upsert", "delete",
+    "mutable_search", "mutable_warmup", "compact", "compaction_stats",
+    "CompactionPolicy", "BackgroundCompactor", "probe_overlap",
+    "save_delta_checkpoint", "apply_delta_checkpoint",
 ]

@@ -161,7 +161,12 @@ def ivf_flat_search(
                      "stream_partials"),
 )
 def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
-                  stream_partials=None):
+                  stream_partials=None, row_mask=None):
+    # ``row_mask``: optional (n + 1,) RUNTIME live mask over slab
+    # positions (the tombstone-deletion input of the mutation tier,
+    # raft_tpu/spatial/ann/mutation.py — the shard_mask trick applied to
+    # rows). 0 = tombstoned: the row scores +inf and can never surface.
+    # A runtime input, so tombstone flips never recompile.
     storage = index.storage
     n_lists = storage.list_index.shape[0]
     L = storage.max_list
@@ -202,6 +207,8 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
         )(o_c).astype(f32)                                   # (LB, L, d)
         pos = o_c[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
         in_list = (pos >= offs[:, None]) & (pos < (offs + szs)[:, None])
+        if row_mask is not None:
+            in_list = in_list & (row_mask[pos] > 0)
         mn = jnp.sum(mv * mv, axis=2)                        # (LB, L)
         dots = jnp.einsum(
             "bqd,bld->bql", qv, mv, preferred_element_type=f32,

@@ -537,7 +537,15 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
                      refine_dataset=None, probes=None,
                      exact_selection=False, approx_recall_target=0.95,
                      stream_partials=None, use_pallas=False,
-                     pallas_interpret=False):
+                     pallas_interpret=False, row_mask=None):
+    # ``row_mask``: optional (n + 1,) RUNTIME live mask over slab
+    # positions (tombstone deletion, spatial/ann/mutation.py). The
+    # one-hot engine folds it into the scan's validity mask; the Pallas
+    # kernel path applies it at the exact-refine tail instead (the
+    # kernel emits sub-chunk minima, so a tombstoned row can still crowd
+    # a pool slot there — it can never SURFACE, and compaction bounds
+    # the density; docs/mutation.md). Runtime input: flips never
+    # recompile.
     from raft_tpu.spatial.ann.common import (
         coarse_probe, invert_probe_map_ranked, regroup_pairs,
         score_l2_candidates, select_candidates,
@@ -613,6 +621,8 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
         )(o_c)                                               # (LB, L, M) u8
         pos = o_c[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
         in_list = (pos >= offs[:, None]) & (pos < (offs + szs)[:, None])
+        if row_mask is not None:
+            in_list = in_list & (row_mask[pos] > 0)
 
         # THE grouped-PQ trick: dist[b,q,l] = sum_m lut[b,q,m,codes[b,l,m]]
         # is a matmul between the flattened LUT and the one-hot code
@@ -814,7 +824,14 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
             (rows_sel >= off_sel[:, :, None])
             & (rows_sel < end_sel[:, :, None])
             & (jnp.isfinite(nadc) & (nadc < pq_kernel.BIG))[:, :, None]
-        ).reshape(nq, c * sub)
+        )
+        if row_mask is not None:
+            # tombstones are applied per ROW at the refine tail on the
+            # kernel path (the in-kernel sub-chunk minima are unmasked)
+            validf = validf & (
+                row_mask[jnp.clip(rows_sel, 0, storage.n)] > 0
+            )
+        validf = validf.reshape(nq, c * sub)
         rpos = rows_sel.reshape(nq, c * sub)
 
         def refine_blk(args):

@@ -7,7 +7,7 @@ makes persistence a practical necessity, so raft_tpu provides it
 natively: one ``.npz`` per index, arrays + a small JSON header carrying
 the static fields. Loading returns device-resident pytrees.
 
-Format (v3): numpy ``.npz`` with keys ``__header__`` (JSON: index type,
+Format (v4): numpy ``.npz`` with keys ``__header__`` (JSON: index type,
 version, static fields, integrity manifest) and one entry per array
 leaf. Portable across hosts; no pickle. The integrity manifest stamps
 each array's CRC32/shape/dtype at save time; ``load_index`` verifies
@@ -19,8 +19,16 @@ as silently wrong neighbors (docs/robustness.md "Checkpoint
 integrity"). v3 adds the sharded indexes' optional two-level coarse
 quantizer (:class:`raft_tpu.spatial.ann.common.CoarseIndex`, nested
 under ``coarse.*`` keys and CRC-manifested like every other array);
-v2 files (no coarse quantizer) and v1 files (no manifest either) still
-load — ``coarse`` comes back ``None``.
+v4 adds the mutation tier (a
+:class:`raft_tpu.spatial.ann.mutation.MutableIndex` payload — delta
+segments, tombstone mask, id map; docs/mutation.md "Checkpoint v4").
+Older files still load (``coarse`` comes back ``None`` from v2/v1),
+the writer stamps the LOWEST version representing the payload, and a
+FUTURE version is rejected with a ``CorruptIndexError`` naming it — a
+rolled-back reader must never fill a newer checkpoint's unknown fields
+from missing-key defaults. Incremental (dirty-list) mutation
+checkpoints ride next to this format in
+:func:`raft_tpu.spatial.ann.mutation.save_delta_checkpoint`.
 """
 
 from __future__ import annotations
@@ -43,10 +51,12 @@ from raft_tpu.sparse.distance import SparseColBlockIndex
 
 __all__ = ["save_index", "load_index"]
 
-_VERSION = 3
+_VERSION = 4
 # v1 = no integrity manifest (read-compat: loads without verification);
-# v2 = manifest but no two-level coarse quantizer (loads, coarse=None)
-_READABLE_VERSIONS = (1, 2, 3)
+# v2 = manifest but no two-level coarse quantizer (loads, coarse=None);
+# v3 = + coarse quantizer; v4 = + mutation tier (a MutableIndex payload
+# with DeltaStore segments — spatial/ann/mutation.py)
+_READABLE_VERSIONS = (1, 2, 3, 4)
 
 _TYPES = {
     "ivf_flat": IVFFlatIndex,
@@ -68,6 +78,21 @@ def _register_sharded() -> None:
         _NAMES[MnmgIVFPQIndex] = "mnmg_ivf_pq"
         _TYPES["mnmg_ivf_flat"] = MnmgIVFFlatIndex
         _NAMES[MnmgIVFFlatIndex] = "mnmg_ivf_flat"
+
+
+def _register_mutable() -> None:
+    # lazy: mutation.py imports the engine modules; registering at
+    # module load would lengthen every import chain for a tier most
+    # processes never touch
+    if "mutable_ivf" not in _TYPES:
+        from raft_tpu.spatial.ann.mutation import DeltaStore, MutableIndex
+
+        _TYPES["mutable_ivf"] = MutableIndex
+        _NAMES[MutableIndex] = "mutable_ivf"
+        _NESTED["DeltaStore"] = DeltaStore
+        # the wrapped engine index nests inside the mutable payload
+        _NESTED["IVFFlatIndex"] = IVFFlatIndex
+        _NESTED["IVFPQIndex"] = IVFPQIndex
 
 
 _NAMES = {v: k for k, v in _TYPES.items()}
@@ -115,12 +140,13 @@ def save_index(index, path) -> None:
     """Serialize an ANN / sparse index to ``path`` (``.npz``; the header
     carries a per-array CRC32/shape/dtype integrity manifest that
     :func:`load_index` verifies). The stamped version is the LOWEST one
-    that can represent the payload — v3 only when a two-level coarse
-    quantizer is attached, v2 otherwise — so checkpoints without the new
-    field stay loadable by previous releases (rollback/mixed-version
-    fleets)."""
+    that can represent the payload — v4 only for a mutation-tier
+    payload, v3 only when a two-level coarse quantizer is attached, v2
+    otherwise — so checkpoints without the new fields stay loadable by
+    previous releases (rollback/mixed-version fleets)."""
     if type(index) not in _NAMES:
         _register_sharded()
+        _register_mutable()
     errors.expects(
         type(index) in _NAMES,
         "save_index: unsupported index type %s (supported: %s)",
@@ -129,12 +155,16 @@ def save_index(index, path) -> None:
     arrays: dict = {}
     static: dict = {}
     _flatten(index, "", arrays, static)
+    # lowest version representing the payload (rollback/mixed-version
+    # fleets): v4 only for a mutation-tier payload, v3 only when a
+    # coarse quantizer is attached, v2 otherwise
+    nested = {
+        v.get("__nested__")
+        for v in static.values() if isinstance(v, dict)
+    }
     version = (
-        _VERSION
-        if any(
-            isinstance(v, dict) and v.get("__nested__") == "CoarseIndex"
-            for v in static.values()
-        )
+        4 if "DeltaStore" in nested
+        else 3 if "CoarseIndex" in nested
         else 2
     )
     # manifest over the bytes actually archived (post bfloat16->uint16
@@ -308,13 +338,22 @@ def _load(path, comms):
                 "index archive, or one damaged beyond recovery",
                 field="__header__",
             ) from e
-        errors.expects(
-            header.get("version") in _READABLE_VERSIONS,
-            "load_index: version %s unsupported (readable: %s)",
-            header.get("version"), list(_READABLE_VERSIONS),
-        )
+        if header.get("version") not in _READABLE_VERSIONS:
+            # a structured, version-NAMING rejection: an unknown FUTURE
+            # version must fail loudly here — falling through would read
+            # fields this release has never heard of as missing-key
+            # defaults and serve silently wrong state (the v3-reader-
+            # meets-v4-checkpoint rollback scenario)
+            raise errors.CorruptIndexError(
+                f"load_index: format version {header.get('version')!r} "
+                f"is not readable by this release (readable: "
+                f"{list(_READABLE_VERSIONS)}) — written by a newer "
+                "release; upgrade before restoring this checkpoint",
+                field="__header__",
+            )
         if header.get("type") not in _TYPES:
             _register_sharded()
+            _register_mutable()
         errors.expects(
             header.get("type") in _TYPES,
             "load_index: unknown index type %r", header.get("type"),

@@ -13,6 +13,10 @@ reproduces bit-for-bit. The injectors cover the serving failure model
   bytes inside a ``.npz`` and REWRITES the archive so the zip container
   stays self-consistent — only the format-v2 CRC32 manifest can catch
   it (``load_index`` → ``CorruptIndexError``);
+* :func:`inject_partial_write` — a partial delta-checkpoint flush
+  (torn-write truncation or a duplicated/stale block) at a chosen
+  member boundary — the mutation tier's mid-ingest crash model
+  (docs/mutation.md);
 * :func:`cancel_after` — arm a delayed cross-thread cancel against an
   in-flight ``Interruptible.synchronize``;
 * :func:`fail_rank` — mark shard(s) down on a
@@ -42,6 +46,7 @@ __all__ = [
     "inject_straggler",
     "inject_nonfinite",
     "corrupt_bytes",
+    "inject_partial_write",
     "cancel_after",
     "fail_rank",
 ]
@@ -239,6 +244,86 @@ def corrupt_bytes(path, *, field: Optional[str] = None, n_bytes: int = 1,
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
         for n in names:
             z.writestr(n, payload[n])
+    return target[:-len(".npy")]
+
+
+def inject_partial_write(path, *, mode: str = "truncate",
+                         boundary: Optional[int] = None,
+                         seed: int = 0) -> str:
+    """Model a PARTIAL flush of a delta-segment checkpoint
+    (:func:`raft_tpu.spatial.ann.mutation.save_delta_checkpoint`) — the
+    mid-ingest crash the mutation tier's recovery story must survive
+    (docs/mutation.md "Checkpoint v4"):
+
+    * ``mode="truncate"`` — a torn write: the file ends at the
+      ``boundary``-th archive member's header offset plus half its
+      stored bytes (headers before the boundary still parse; the zip
+      central directory is gone). ``load``/``apply`` must fail with
+      :class:`raft_tpu.errors.CorruptIndexError`, never half-apply.
+    * ``mode="duplicate"`` — a doubled/stale block write: the
+      ``boundary``-th array member's payload is overwritten with the
+      PREVIOUS member's bytes and the archive rewritten self-consistent
+      (container CRCs match the damage) — only the v4 per-array CRC32
+      manifest can catch it.
+
+    ``boundary`` indexes the non-header members in archive order
+    (default: the middle member, deterministic from ``seed`` when the
+    archive has one candidate pair). Returns the damaged member name
+    (without ``.npy``).
+    """
+    errors.expects(
+        mode in ("truncate", "duplicate"),
+        "inject_partial_write: mode=%r not in ('truncate', 'duplicate')",
+        mode,
+    )
+    with zipfile.ZipFile(path) as z:
+        infos = z.infolist()
+        payload = {i.filename: z.read(i.filename) for i in infos}
+    members = [
+        i.filename for i in infos if i.filename != "__header__.npy"
+    ]
+    errors.expects(
+        bool(members),
+        "inject_partial_write: %s holds no array members", path,
+    )
+    rng = np.random.default_rng(seed)
+    if boundary is None:
+        boundary = len(members) // 2 if len(members) > 1 else 0
+    errors.expects(
+        0 <= boundary < len(members),
+        "inject_partial_write: boundary=%d out of range [0, %d)",
+        boundary, len(members),
+    )
+    target = members[boundary]
+    if mode == "duplicate":
+        src = members[boundary - 1] if boundary > 0 else members[
+            min(boundary + 1, len(members) - 1)
+        ]
+        if src == target and len(members) == 1:
+            # single member: stale payload is a shuffled copy of itself
+            buf = bytearray(payload[target])
+            pos = 128 + rng.choice(max(len(buf) - 128, 1), size=1)[0]
+            buf[int(pos)] ^= 0xFF
+            payload[target] = bytes(buf)
+        else:
+            payload[target] = payload[src]
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
+            for i in infos:
+                z.writestr(i.filename, payload[i.filename])
+        return target[:-len(".npy")]
+    # torn write: rewrite uncompressed, then cut the FILE at the target
+    # member's data midpoint — everything after (later members, central
+    # directory) is simply gone
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
+        for i in infos:
+            z.writestr(i.filename, payload[i.filename])
+    with zipfile.ZipFile(path) as z:
+        info = next(i for i in z.infolist() if i.filename == target)
+        cut = info.header_offset + max(
+            1, (len(info.filename) + 30 + info.file_size) // 2
+        )
+    with open(path, "rb+") as f:
+        f.truncate(cut)
     return target[:-len(".npy")]
 
 

@@ -573,6 +573,98 @@ def test_adc_gather_suppression_honored():
     assert out == []
 
 
+# -- mutation-retrace --------------------------------------------------------
+
+def test_mutation_retrace_flags_int_coercion():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def bad(delta_counts, l):
+            return int(delta_counts[l])
+    """, rule="mutation-retrace")
+    assert len(out) == 1
+    assert "int(delta_counts)" in out[0].message
+
+
+def test_mutation_retrace_flags_if_and_while_on_state():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def bad(tombstones, n_dead, x):
+            if tombstones.any():
+                x = -x
+            while n_dead > 0:
+                x = x + 1
+            return x
+    """, rule="mutation-retrace")
+    assert len(out) == 2
+
+
+def test_mutation_retrace_flags_range_and_item_dotted():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def bad(delta, row_mask, x):
+            for i in range(delta.counts[0]):
+                x = x + 1
+            return x + row_mask.item()
+    """, rule="mutation-retrace")
+    assert len(out) == 2
+    assert any("range(delta_counts)" in f.message for f in out)
+    assert any("row_mask.item()" in f.message for f in out)
+
+
+def test_mutation_retrace_presence_test_and_runtime_use_clean():
+    # `is None` presence checks are pytree structure (legitimate
+    # statics); jnp.where on the runtime value is THE intended pattern
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def good(x, row_mask=None):
+            if row_mask is not None:
+                x = jnp.where(row_mask > 0, x, jnp.inf)
+            return x
+    """, rule="mutation-retrace")
+    assert out == []
+
+
+def test_mutation_retrace_host_side_clean():
+    out = findings("""
+        def compaction_stats(delta_counts, tombstones):
+            return int(delta_counts.sum()), bool(tombstones.any())
+    """, rule="mutation-retrace")
+    assert out == []
+
+
+def test_mutation_retrace_unrelated_names_clean():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def good(alive, delta_cap, x):
+            if alive is None:
+                return x
+            return x[:delta_cap] + int(x.shape[0])
+    """, rule="mutation-retrace")
+    assert out == []
+
+
+def test_mutation_retrace_suppression_honored():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def pinned(delta_counts, x):
+            return x[:int(delta_counts)]  # jaxlint: disable=mutation-retrace
+    """, rule="mutation-retrace")
+    assert out == []
+
+
 # -- engine: baseline, CLI, self-gate ---------------------------------------
 
 FIXTURE_BAD = textwrap.dedent("""

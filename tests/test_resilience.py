@@ -1236,7 +1236,11 @@ def test_future_version_rejected(small_index, tmp_path):
             ),
             **arrays,
         )
-    with pytest.raises(ValueError, match="version"):
+    # ISSUE 7 satellite: a structured, version-NAMING rejection (a
+    # CorruptIndexError, deliberately NOT a ValueError) — a rolled-back
+    # reader must fail loudly instead of filling a newer checkpoint's
+    # unknown fields from missing-key defaults
+    with pytest.raises(errors.CorruptIndexError, match="99"):
         load_index(p)
 
 

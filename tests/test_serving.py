@@ -377,7 +377,7 @@ def test_serving_latency_rows_tiny_config():
     out = serving_latency_rows(
         n=8192, d=8, k=4, n_probes=4, n_lists=8, nqs=(1, 4),
         engines=("ivf_flat",), chain=(1, 3), escalate=0,
-        hedged=False, overload=False,
+        hedged=False, overload=False, mixed=False,
     )
     assert out["unit"] == "ms"
     assert [r["nq"] for r in out["rows"]] == [1, 4]
@@ -494,3 +494,102 @@ def test_round6_bench_line_parses(benchtop_module=None):
     vals = [e.get("value") for e in parsed["extras"]
             if "value" in e]
     assert vals[:8] == [10000.0 + i for i in range(8)]
+
+
+def test_mixed_ingest_row_tiny_config():
+    """ISSUE 7: the mixed read/write row on a tiny CPU config — frozen
+    vs under-ingest search QPS (ratio stamped), sustained ingest QPS,
+    and the upsert→visible / delete→masked latencies, all through
+    chained_dispatch_stats (escalations stamped)."""
+    from bench.bench_serving import mixed_ingest_row
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((4096, 8)).astype(np.float32)
+    idx = ivf_flat_build(
+        x, IVFFlatParams(n_lists=8, kmeans_n_iters=3, seed=2),
+        metric="sqeuclidean",
+    )
+    qb = jnp.asarray(x[:8] + 0.01)
+    row = mixed_ingest_row(idx, qb, k=4, n_probes=4, ingest_batch=16,
+                           chain=(1, 3), escalate=0)
+    assert row["scenario"] == "mixed_ingest"
+    assert row["ingest_batch"] == 16
+    assert "error" not in row
+    for key in ("frozen_qps", "mixed_search_qps", "qps_ratio_vs_frozen",
+                "ingest_qps", "escalations", "spread",
+                "upsert_visible_ms", "delete_masked_ms"):
+        assert key in row, key
+    assert row["mixed_search_qps"] > 0 and row["frozen_qps"] > 0
+    assert row["upsert_visible_ms"] > 0
+    assert row["delete_masked_ms"] > 0
+
+
+def test_round7_bench_line_parses_with_mixed_ingest():
+    """ISSUE 7 satellite (the _fit_line parse/cap test extended): the
+    round-7 artifact shape — every prior row PLUS the mixed_ingest
+    serving row — must print as a line that json.loads-round-trips
+    under the 1800-char driver cap, with the mutation row's headline
+    ratio surviving every trim stage."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r7", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    serving_rows = [
+        {"engine": e, "nq": nq, "p50_ms": 1.2345, "spread": 0.08,
+         "repeats": 5, "qcap": 24}
+        for e in ("fused_knn", "ivf_flat", "ivf_pq")
+        for nq in (1, 128, 1024)
+    ] + [
+        {"engine": "ivf_flat", "scenario": "hedged_straggler", "nq": 128,
+         "p50_ms": 1.9, "p99_ms": 31.4, "hedged_p99_ms": 6.2,
+         "n_requests": 64},
+        {"engine": "ivf_flat", "scenario": "overload_2x", "nq": 128,
+         "p50_ms": 2.0, "shed_rate": 0.47, "p99_ms": 22.7},
+        {"engine": "ivf_flat", "scenario": "mixed_ingest", "nq": 128,
+         "ingest_batch": 256, "qcap": 24, "frozen_qps": 52000.0,
+         "ingest_qps": 310000.0, "mixed_search_qps": 45000.0,
+         "spread": 0.06, "repeats": 5, "escalations": 1,
+         "qps_ratio_vs_frozen": 0.865, "upsert_visible_ms": 4.2,
+         "delete_masked_ms": 2.9},
+    ]
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01,
+         "vs_prev_qcap8_qps": 0.99, "vs_prev_build_warm_s": 1.0}
+        for i in range(8)
+    ] + [
+        {"metric": "serving_p50_500000x96_k10_p16", "unit": "ms",
+         "rows": serving_rows},
+        {"metric": "warm_start_build_500000x96", "unit": "s",
+         "value": 3.1, "build_warm_s": 1.9, "within_2x_warm": True},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # the headline ratio survives whatever trimming was needed — it is
+    # not in _TRIM_ORDER, and mixed_search_qps only falls with "rows"
+    if any("rows" in e for e in parsed.get("extras", [])):
+        srv = next(e for e in parsed["extras"] if "rows" in e)
+        mrow = next(r for r in srv["rows"]
+                    if r.get("scenario") == "mixed_ingest")
+        assert mrow["qps_ratio_vs_frozen"] == 0.865
+        assert "mixed_search_qps" in mrow
