@@ -1036,6 +1036,9 @@ def _load_prev_bench():
             for ex in row.get("extras", []):
                 if "value" in ex:
                     prev[ex["metric"]] = ex
+            for p_row in prev.values():   # a stale artifact must not
+                for key in _RETIRED_KEYS:  # re-seed retired keys
+                    p_row.pop(key, None)
             return prev
         except Exception:
             continue
@@ -1094,7 +1097,21 @@ _PRINT_KEYS = {
     # frozen engine, sustained ingest rate, mutation visibility
     "mixed_search_qps", "frozen_qps", "qps_ratio_vs_frozen",
     "ingest_qps", "upsert_visible_ms", "delete_masked_ms",
+    # the open-loop executor row (ISSUE 8, docs/serving.md "Open-loop
+    # serving"): measured saturation vs the raw program and the
+    # offered-load sweep percentiles at 50/80/95% of saturation
+    "program_qps", "saturation_qps", "qps_ratio_vs_program",
+    "p50_ms_50", "p99_ms_50", "p50_ms_80", "p99_ms_80",
+    "p50_ms_95", "p99_ms_95", "shed_rate_95",
 }
+
+
+# keys RETIRED from the artifact (PR 4 replaced the modeled
+# projected_100m_qps arithmetic with the measured sharded_e2e_qps, yet
+# BENCH_r05's shard rows still carried all three): stripped from every
+# printed row AND from prior-round rows before vs_prev stamping, so a
+# stale artifact can never resurrect them
+_RETIRED_KEYS = ("probe_global_ms", "projected_100m_qps", "merge8_ms")
 
 
 # secondary keys dropped (in order, recursively incl. their vs_prev_*
@@ -1104,6 +1121,7 @@ _PRINT_KEYS = {
 _TRIM_ORDER = (
     "repeats", "within_2x_warm", "escalations", "probe_flop_ratio",
     "build_warm_s",
+    "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "f32_highest_gflops", "bf16_iters_per_s", "measured_chip_qps",
     "brute_force_same_shape_qps", "qcap8_qps", "build_s",
@@ -1170,6 +1188,9 @@ def _compact(row):
     must not sneak back onto the line)."""
     out = {}
     for key, v in row.items():
+        if key in _RETIRED_KEYS or \
+                key.removeprefix("vs_prev_") in _RETIRED_KEYS:
+            continue          # retired artifact keys never print again
         if key not in _PRINT_KEYS and not key.startswith("vs_prev"):
             continue
         if isinstance(v, str) and key not in (

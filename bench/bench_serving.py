@@ -37,6 +37,23 @@ A third ``mixed_ingest`` row measures the mutation tier
 to the frozen-index QPS (``qps_ratio_vs_frozen`` — acceptance >= ~0.8
 at equal recall), sustained ``ingest_qps``, and the upsert->visible /
 delete->masked latencies (:func:`mixed_ingest_row`).
+
+The ``open_loop`` row (ISSUE 8, docs/serving.md "Open-loop serving")
+measures the serving EXECUTOR, not the program: a deterministic seeded
+Poisson arrival stream (``raft_tpu.testing.load``) is driven through
+``raft_tpu.serving.ServingExecutor`` (shape-bucketed micro-batching +
+async pipelined dispatch), and the row reports
+
+* ``program_qps`` — the raw compiled-program QPS at the largest
+  bucket (closed-loop chained quotient, the denominator of the
+  acceptance ratio);
+* ``saturation_qps`` — measured open-loop completion rate with the
+  arrival stream offered ABOVE capacity (admission sheds the excess);
+* ``qps_ratio_vs_program`` — saturation over program QPS: the
+  executor's dispatch-gap overhead (acceptance >= ~0.8);
+* ``p50_ms_50/p99_ms_50`` (and ``_80``, ``_95``) — per-request
+  latency percentiles at 50%/80%/95% of the measured saturation —
+  the offered-load sweep that shows WHERE the latency knee sits.
 """
 
 from __future__ import annotations
@@ -344,12 +361,179 @@ def mixed_ingest_row(idx, qb, *, k: int = 10, n_probes: int = 16,
     return row
 
 
+def _drive_open_loop(executor, schedule, qall, *, seed: int = 0):
+    """Replay one open-loop schedule through the executor; returns
+    ``(latencies_ms, n_shed, achieved_qps, max_lag_s)``. Latency is
+    submit→future-resolution wall time per COMPLETED request; achieved
+    QPS counts completed query rows over the span from first submit to
+    last completion (the open-loop throughput, sheds excluded)."""
+    from raft_tpu import errors
+    from raft_tpu.testing import load
+
+    done = {}
+    lock = threading.Lock()
+    rng = np.random.default_rng(seed)
+    q_pool = np.asarray(qall, np.float32)
+
+    def submit(i, size):
+        rows = q_pool[rng.integers(0, q_pool.shape[0], size=size)]
+        fut = executor.submit(rows * (1.0 + 1e-6 * (i + 1)))
+
+        def _stamp(_f, i=i):
+            with lock:
+                done[i] = time.perf_counter()
+
+        fut.add_done_callback(_stamp)
+        return fut
+
+    results, stamps, max_lag = load.replay(
+        schedule, submit, clock=time.perf_counter
+    )
+    lat_ms, n_shed, rows_done = [], 0, 0
+    t_last = 0.0
+    for i, r in enumerate(results):
+        if isinstance(r, errors.RaftOverloadError):
+            n_shed += 1
+            continue
+        if isinstance(r, BaseException):
+            raise r
+        r.result(timeout=120)            # surface dispatch failures
+        # result() can return before add_done_callback has stamped
+        # (set_result wakes waiters first, runs callbacks after) —
+        # spin the tiny gap out instead of KeyError-ing the row
+        while True:
+            with lock:
+                t_done = done.get(i)
+            if t_done is not None:
+                break
+            time.sleep(0.0002)
+        lat_ms.append((t_done - stamps[i]) * 1e3)
+        rows_done += int(schedule.sizes[i])
+        t_last = max(t_last, t_done)
+    span = max(t_last - float(stamps[0]), 1e-9) if lat_ms else None
+    qps = rows_done / span if span else 0.0
+    return lat_ms, n_shed, qps, max_lag
+
+
+def open_loop_row(make_run, qall, *, buckets=(128, 1024),
+                  request_size: int = 16, n_requests: int = 256,
+                  fracs=(0.5, 0.8, 0.95), flush_age_s: float = 0.002,
+                  max_in_flight: int = 4, chain=(4, 32),
+                  escalate: int = 2, seed: int = 11,
+                  min_duration_s: float = 0.5,
+                  max_requests: int = 20_000) -> dict:
+    """The open-loop executor row (module docstring): saturation vs the
+    raw program, then the offered-load sweep at ``fracs`` of measured
+    saturation with p50/p99 per point.
+
+    ``make_run(bucket)`` returns the WARMED serving closure for one
+    bucket size (the bench warms ``index.warmup(bucket)`` per bucket);
+    the executor routes each micro-batch to its bucket's closure.
+
+    ``n_requests`` is a FLOOR: each measured point is stretched to at
+    least ``min_duration_s`` of offered traffic at its own rate
+    (capped at ``max_requests``) — at TPU rates a fixed request count
+    would finish in milliseconds and measure noise, not serving."""
+    from bench.common import chained_dispatch_stats
+    from raft_tpu.resilience import AdmissionController
+    from raft_tpu.serving import BucketSet, ServingExecutor
+    from raft_tpu.testing.load import poisson_arrivals
+
+    bset = BucketSet.of(buckets)
+    runs = {b: make_run(b) for b in bset.sizes}
+    d = int(np.asarray(qall).shape[1])
+
+    def dispatch(batch, **_rt):
+        return runs[int(batch.shape[0])](batch)
+
+    # warm every bucket program before the clock starts
+    for b in bset.sizes:
+        jax.block_until_ready(runs[b](jnp.zeros((b, d), jnp.float32)))
+
+    # the denominator: raw program QPS at the largest bucket,
+    # closed-loop chained quotient (no executor in the path)
+    big = bset.largest
+    qb = jnp.asarray(np.asarray(qall, np.float32)[:big])
+    st = chained_dispatch_stats(
+        lambda s: qb * (1.0 + 1e-6 * s), runs[big],
+        n1=chain[0], n2=chain[1], escalate=escalate,
+    )
+    row = {
+        "engine": "ivf_flat", "scenario": "open_loop",
+        "nq": big, "buckets": list(bset.sizes),
+        "request_size": int(request_size),
+        "n_requests": int(n_requests),
+        "max_in_flight": int(max_in_flight),
+    }
+    if st is None:
+        row["error"] = "jitter-dominated"
+        return row
+    program_qps = big / (st["ms"] / 1e3)
+    row["program_qps"] = round(program_qps, 1)
+    row["spread"] = st["spread"]
+    row["repeats"] = st["repeats"]
+
+    def fresh_executor():
+        return ServingExecutor(
+            dispatch, bset, dim=d, flush_age_s=flush_age_s,
+            max_in_flight=max_in_flight,
+            admission=AdmissionController(
+                max_concurrent=max(1, 4 * big // request_size),
+                max_queue=max(8, 4 * big // request_size),
+            ),
+        )
+
+    def n_for(rate_rps):
+        return int(min(max_requests,
+                       max(n_requests, min_duration_s * rate_rps)))
+
+    # saturation: offer ~1.5x the program rate; the completion rate IS
+    # the executor's deliverable throughput (sheds excluded)
+    rate_rps = 1.5 * program_qps / request_size
+    with fresh_executor() as ex:
+        _, _, sat_qps, sat_lag = _drive_open_loop(
+            ex, poisson_arrivals(rate_rps, n_for(rate_rps), seed=seed,
+                                 sizes=request_size),
+            qall, seed=seed,
+        )
+    row["saturation_qps"] = round(sat_qps, 1)
+    row["qps_ratio_vs_program"] = round(sat_qps / program_qps, 3)
+    # generator self-check (bench_full only): a lag comparable to the
+    # mean inter-arrival gap means the measured rate was submit-bound
+    row["gen_lag_ms_sat"] = round(sat_lag * 1e3, 3)
+
+    # the offered-load sweep: p50/p99 at each fraction of saturation
+    for frac in fracs:
+        tag = f"{int(round(frac * 100))}"
+        offered = frac * sat_qps / request_size
+        if offered <= 0:
+            continue
+        n_point = n_for(offered)
+        with fresh_executor() as ex:
+            lat_ms, n_shed, qps, lag = _drive_open_loop(
+                ex, poisson_arrivals(offered, n_point,
+                                     seed=seed + int(frac * 100),
+                                     sizes=request_size),
+                qall, seed=seed + 1,
+            )
+        row[f"gen_lag_ms_{tag}"] = round(lag * 1e3, 3)
+        if lat_ms:
+            lat = np.asarray(lat_ms)
+            row[f"p50_ms_{tag}"] = round(float(np.percentile(lat, 50)), 3)
+            row[f"p99_ms_{tag}"] = round(float(np.percentile(lat, 99)), 3)
+            row[f"achieved_qps_{tag}"] = round(qps, 1)
+        if n_shed:
+            row[f"shed_rate_{tag}"] = round(n_shed / n_point, 3)
+    return row
+
+
 def serving_latency_rows(
     n: int = 500_000, d: int = 96, k: int = 10, n_probes: int = 16,
     n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
                                            "ivf_pq"),
     chain=(4, 32), escalate: int = 2,
     hedged: bool = True, overload: bool = True, mixed: bool = True,
+    open_loop: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
@@ -484,6 +668,36 @@ def serving_latency_rows(
         except Exception as e:                       # noqa: BLE001
             rows.append({
                 "engine": "ivf_flat", "scenario": "resilience",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+
+    # the open-loop executor row (ISSUE 8): saturation vs the raw
+    # program + the offered-load sweep with p50/p99 per point
+    if open_loop and "ivf_flat" in engines:
+        try:
+            idx = get_index("ivf_flat")
+            ol_buckets = tuple(sorted({nq for nq in nqs if nq > 1})
+                               or {max(nqs)})
+
+            def make_run(bucket, idx=idx):
+                qcap = idx.warmup(bucket, k=k, n_probes=n_probes)
+
+                def run(qq, qcap=qcap):
+                    return ivf_flat_search_grouped(
+                        idx, qq, k, n_probes=n_probes, qcap=qcap,
+                    )
+                return run
+
+            rows.append(open_loop_row(
+                make_run, np.asarray(qall),
+                buckets=ol_buckets,
+                request_size=max(1, min(16, max(ol_buckets) // 8)),
+                n_requests=min(256, 32 * len(ol_buckets) * 4),
+                chain=chain, escalate=escalate,
+            ))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "ivf_flat", "scenario": "open_loop",
                 "error": f"{type(e).__name__}: {e}"[:160],
             })
 

@@ -56,6 +56,7 @@ __all__ = [
     "pylibraft",
     "random",
     "resilience",
+    "serving",
     "sparse",
     "spatial",
     "spectral",
@@ -68,8 +69,8 @@ __all__ = [
 _SUBMODULES = {
     "analysis", "cache", "cluster", "comms", "compat", "core", "distance",
     "errors", "label", "lap", "linalg", "matrix", "native", "pylibraft",
-    "random", "resilience", "sparse", "spatial", "spectral", "stats",
-    "testing", "utils",
+    "random", "resilience", "serving", "sparse", "spatial", "spectral",
+    "stats", "testing", "utils",
 }
 
 

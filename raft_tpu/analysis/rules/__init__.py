@@ -27,6 +27,7 @@ def _collect() -> List[Rule]:
         mutation_retrace,
         prng_discipline,
         recompile_hazard,
+        sync_in_hot_path,
         tracer_safety,
         x64_hygiene,
     )
@@ -34,7 +35,7 @@ def _collect() -> List[Rule]:
     out: List[Rule] = []
     for mod in (api_compat, tracer_safety, recompile_hazard,
                 x64_hygiene, prng_discipline, adc_gather,
-                mutation_retrace):
+                mutation_retrace, sync_in_hot_path):
         out.extend(mod.RULES)
     return out
 

@@ -29,10 +29,41 @@ dispatches:
   completed / queue depth / peak depth — the shed-rate observability
   the overload bench row reports.
 
+Two entry styles share the same bounds, counters, and pricing:
+
+* the **blocking** context manager (``with ctrl.admit(): dispatch()``)
+  — one thread per request, the queue wait happens inside ``admit``;
+* the **asynchronous** triple :meth:`AdmissionController.enqueue` /
+  :meth:`~AdmissionController.begin_service` /
+  :meth:`~AdmissionController.finish_service` — the open-loop serving
+  executor's path (:mod:`raft_tpu.serving`), where a request is queued
+  by the arrival thread, dispatched inside a micro-batch by the batcher
+  thread, and completed by the demux thread. ``enqueue`` NEVER blocks:
+  an open-loop arrival stream must be answered shed-or-queued
+  immediately, not slowed to the service rate. Its bound is the
+  blocking path's TOTAL capacity — queued + in-service requests vs
+  ``max_queue + max_concurrent`` — because a request the blocking
+  world would have handed a free slot immediately sits in the async
+  queue until the batcher picks it up. ``max_concurrent`` is not
+  re-checked at :meth:`~AdmissionController.begin_service`: the
+  executor coalesces queued requests into micro-batches (its
+  ``max_in_flight`` window bounds device concurrency), so in-service
+  REQUEST count legitimately exceeds concurrent program count.
+
+``retry_after_s`` pricing is occupancy-aware: the per-request service
+estimate is the completion-measured EWMA *or the age of the oldest
+request still in service, whichever is larger*. The EWMA alone is
+updated only on completions, so a burst landing after an idle stretch
+(or a service-time regression) would price retries from stale history
+while the evidence of the true current service time — how long the
+in-flight work has already been running — sits unread in the occupancy
+(regression-tested with an injected clock).
+
 Everything is host-side and thread-safe; the injected ``clock`` makes
-the token limiter deterministic under test. Timeouts while QUEUED raise
-:class:`raft_tpu.errors.RaftTimeoutError` (the caller's deadline
-expired — same classification as a slow dispatch), never an overload.
+the limiter and the pricing deterministic under test. Timeouts while
+QUEUED raise :class:`raft_tpu.errors.RaftTimeoutError` (the caller's
+deadline expired — same classification as a slow dispatch), never an
+overload.
 """
 
 from __future__ import annotations
@@ -136,6 +167,11 @@ class AdmissionController:
         self._shed_rate = 0
         self._timed_out = 0
         self._service_ewma_s: Optional[float] = None
+        # requests currently IN SERVICE: ticket -> (start stamp, n).
+        # The stamps feed occupancy-aware retry_after pricing (the age
+        # of the oldest in-flight work bounds the estimate from below)
+        self._inflight_started: dict = {}
+        self._next_ticket = 0
         # token bucket state (continuous refill at `rate`/s up to burst)
         self._tokens = float(self.burst or 0)
         self._token_stamp = clock()
@@ -164,13 +200,43 @@ class AdmissionController:
         with self._lock:
             return self._in_flight
 
+    def _service_estimate(self) -> Optional[float]:
+        """Per-request service-time estimate for pricing: the
+        completion-measured EWMA, floored by the AGE of the oldest
+        request still in service. The EWMA only moves on completions, so
+        after an idle stretch (or a service-time regression) it is stale
+        exactly when a burst arrives — but the in-flight occupancy
+        already shows the truth: work that has been running for 80 ms is
+        evidence the next slot will not free in the 2 ms the old EWMA
+        remembers."""
+        est = self._service_ewma_s
+        if self._inflight_started:
+            # PER-REQUEST age: a ticket is a whole micro-batch, and
+            # pricing a 64-request batch's 80 ms age as 80 ms/request
+            # would overprice retries by the batch size — amortize
+            # exactly like finish_service does for the EWMA
+            now = self._clock()
+            floor = max(
+                (now - t0) / max(n, 1)
+                for t0, n in self._inflight_started.values()
+            )
+            est = max(est or 0.0, floor)
+        return est
+
     def _retry_after(self, waiters: int) -> Optional[float]:
         """Price the queue ahead of a shed client: (queued + in-flight)
-        service times at the measured EWMA; the configured fallback
-        before any completion has been measured."""
-        if self._service_ewma_s is None:
+        service times at the occupancy-floored EWMA
+        (:meth:`_service_estimate`); the configured fallback before any
+        service evidence exists. Before the FIRST completion the
+        fallback also floors the occupancy price — a request that
+        started microseconds ago is not evidence service is fast."""
+        est = self._service_estimate()
+        if est is None:
             return self.retry_after_s
-        return (waiters + self._in_flight) * self._service_ewma_s
+        priced = (waiters + self._in_flight) * est
+        if self._service_ewma_s is None and self.retry_after_s is not None:
+            priced = max(priced, self.retry_after_s)
+        return priced
 
     def _refill_tokens(self, now: float) -> None:
         self._tokens = min(
@@ -241,21 +307,115 @@ class AdmissionController:
                     self._slot_free.wait(wait)
             finally:
                 self._queue_depth -= 1
-            self._in_flight += 1
-            self._admitted += 1
-        t0 = time.monotonic()
+            ticket = self._begin_locked(1)
         try:
             yield self
         finally:
-            held = time.monotonic() - t0
-            with self._lock:
-                self._in_flight -= 1
-                self._completed += 1
-                self._service_ewma_s = (
-                    held if self._service_ewma_s is None
-                    else 0.8 * self._service_ewma_s + 0.2 * held
+            self.finish_service(ticket)
+
+    # -- the asynchronous (executor) path ------------------------------------
+    def _begin_locked(self, n: int) -> int:
+        """Move ``n`` requests into service (lock held): counters, and
+        the in-flight start stamp that feeds occupancy pricing."""
+        self._in_flight += n
+        self._admitted += n
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._inflight_started[ticket] = (self._clock(), n)
+        return ticket
+
+    def enqueue(self, n: int = 1) -> None:
+        """NON-BLOCKING admission into the bounded queue — the open-loop
+        arrival path (:class:`raft_tpu.serving.ServingExecutor` calls
+        this from ``submit``). Sheds immediately with
+        :class:`~raft_tpu.errors.RaftOverloadError` (occupancy-priced
+        ``retry_after_s``) when total outstanding work (queued + in
+        service) is at ``max_queue + max_concurrent`` or the token
+        limiter is empty; otherwise the request is QUEUED and the
+        caller returns to the arrival stream without waiting for a
+        slot. Dispatch/completion are reported later via
+        :meth:`begin_service` / :meth:`finish_service`;
+        :meth:`cancel_queued` gives up a queued spot (shutdown, caller
+        timeout)."""
+        errors.expects(n >= 1, "enqueue: n=%d < 1", n)
+        with self._lock:
+            # the async bound is TOTAL OUTSTANDING (queued + in service)
+            # vs max_queue + max_concurrent — the blocking path's total
+            # capacity. A pure queue-depth check would shed a default
+            # (1, 0) controller's every request on an IDLE server: the
+            # async queue holds requests a free slot would have absorbed
+            # immediately in the blocking world.
+            cap = self.max_queue + self.max_concurrent
+            if self._queue_depth + self._in_flight + n > cap:
+                self._shed_queue += n
+                raise errors.RaftOverloadError(
+                    f"admission capacity full ({self._queue_depth} "
+                    f"waiting + {self._in_flight} in flight >= "
+                    f"max_queue={self.max_queue} + max_concurrent="
+                    f"{self.max_concurrent})",
+                    retry_after_s=self._retry_after(self._queue_depth),
                 )
-                self._slot_free.notify()
+            if self.rate is not None:
+                self._refill_tokens(self._clock())
+                if self._tokens < float(n):
+                    self._shed_rate += n
+                    raise errors.RaftOverloadError(
+                        f"rate limit exhausted ({self.rate}/s, burst "
+                        f"{self.burst})",
+                        retry_after_s=(float(n) - self._tokens) / self.rate,
+                    )
+                self._tokens -= float(n)
+            self._queue_depth += n
+            self._peak_queue = max(self._peak_queue, self._queue_depth)
+
+    def begin_service(self, n: int = 1) -> int:
+        """Report ``n`` queued requests dispatched (queue → in service).
+        Returns the service ticket to pass to :meth:`finish_service`.
+        The executor calls this when a micro-batch leaves the batcher;
+        from this stamp on, the batch's age floors the retry-after
+        pricing (:meth:`_service_estimate`)."""
+        errors.expects(n >= 1, "begin_service: n=%d < 1", n)
+        with self._lock:
+            errors.expects(
+                self._queue_depth >= n,
+                "begin_service: %d requested but only %d queued",
+                n, self._queue_depth,
+            )
+            self._queue_depth -= n
+            return self._begin_locked(n)
+
+    def finish_service(self, ticket: int) -> None:
+        """Report a service ticket complete: counters, slot release, and
+        the per-request service-time EWMA (batch held-time amortized
+        over its ``n`` requests) that prices later sheds."""
+        with self._lock:
+            t0, n = self._inflight_started.pop(ticket)
+            held = max(0.0, self._clock() - t0) / max(n, 1)
+            self._in_flight -= n
+            self._completed += n
+            self._service_ewma_s = (
+                held if self._service_ewma_s is None
+                else 0.8 * self._service_ewma_s + 0.2 * held
+            )
+            self._slot_free.notify(n)
+
+    def abort_service(self, ticket: int) -> None:
+        """Release a service ticket whose dispatch FAILED: the slot
+        frees (waiters wake), but neither the service-time EWMA nor
+        ``completed`` moves — a crashed dispatch is not service-time
+        evidence (a near-zero ``held`` would drag the EWMA toward 0 and
+        underprice every later shed), and its requests were answered
+        with an exception, not served."""
+        with self._lock:
+            _t0, n = self._inflight_started.pop(ticket)
+            self._in_flight -= n
+            self._slot_free.notify(n)
+
+    def cancel_queued(self, n: int = 1) -> None:
+        """Give back ``n`` queued spots without serving them (executor
+        shutdown, a caller abandoning its queued request)."""
+        with self._lock:
+            self._queue_depth -= min(n, self._queue_depth)
 
     def __repr__(self) -> str:
         s = self.stats()

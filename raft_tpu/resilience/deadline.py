@@ -42,7 +42,7 @@ from raft_tpu.core.interruptible import Interruptible
 
 __all__ = [
     "Deadline", "RetryPolicy", "dispatch_with_deadline",
-    "HedgePolicy", "dispatch_hedged",
+    "HedgePolicy", "dispatch_hedged", "wait_first",
 ]
 
 
@@ -294,13 +294,18 @@ def _ready_leaves(x) -> list:
     ]
 
 
-def _wait_first(candidates, *, timeout_s: Optional[float],
-                poll_interval_s: float = 0.0005,
-                max_poll_interval_s: float = 0.02) -> int:
+def wait_first(candidates, *, timeout_s: Optional[float],
+               poll_interval_s: float = 0.0005,
+               max_poll_interval_s: float = 0.02) -> int:
     """Index of the FIRST fully-ready candidate (every ``is_ready`` leaf
     ready), polling with the same cancellable backoff loop as
     ``Interruptible.synchronize``; :class:`raft_tpu.errors.RaftTimeoutError`
-    if none is ready within ``timeout_s``."""
+    if none is ready within ``timeout_s``. Public for custom dispatch
+    layers racing replica candidates the way :func:`dispatch_hedged`
+    does. (The open-loop executor's drain loop implements the same
+    readiness idiom NON-blocking — it sweeps many in-flight batches per
+    poll instead of parking on one candidate set, so it cannot call
+    this helper.)"""
     pending = [_ready_leaves(c) for c in candidates]
     deadline = (
         None if timeout_s is None else time.monotonic() + timeout_s
@@ -399,7 +404,7 @@ def dispatch_hedged(
     backup = (backup_fn if backup_fn is not None else fn)(
         *args, **kwargs
     )
-    winner = _wait_first(
+    winner = wait_first(
         (primary, backup),
         timeout_s=overall.remaining() if overall.bounded else None,
     )

@@ -1,0 +1,43 @@
+"""Open-loop serving for the warmed one-dispatch search programs.
+
+The compiled serving programs (docs/serving.md) answer a batch in one
+dispatch; this package is the executor ABOVE them that a production
+front end actually needs — the layer the reference never grew past its
+``raft::handle_t`` resource container (SURVEY "What RAFT is"), and the
+layer that turns the measured program QPS into deliverable open-loop
+throughput (ROADMAP item 3, "millions of users"):
+
+* :class:`~raft_tpu.serving.batching.BucketSet` /
+  :func:`~raft_tpu.serving.batching.pack_requests` — shape-bucketed
+  micro-batching: arrivals coalesce into EXACTLY the warmed
+  ``warmup(nq)`` batch shapes, so steady-state serving never retraces;
+* :class:`~raft_tpu.serving.executor.ServingExecutor` — the open-loop
+  executor: pipelined host→device staging, a bounded async-dispatch
+  in-flight window, completion-order demux back to per-request
+  futures, with :class:`~raft_tpu.resilience.AdmissionController`
+  shedding at the door,
+  :class:`~raft_tpu.resilience.HedgePolicy`-driven straggler hedging
+  onto a backup replica, and ``shard_mask``/``FailoverPlan`` route
+  arrays flowing through as runtime inputs;
+* the deterministic Poisson load generator feeding it lives in
+  :mod:`raft_tpu.testing.load` (seeded open-loop arrival schedules —
+  the bench's offered-load sweep and the chaos suite replay the same
+  traffic).
+"""
+
+from raft_tpu.serving.batching import (
+    BucketSet,
+    MicroBatch,
+    PendingRequest,
+    pack_requests,
+)
+from raft_tpu.serving.executor import ExecutorStats, ServingExecutor
+
+__all__ = [
+    "BucketSet",
+    "MicroBatch",
+    "PendingRequest",
+    "pack_requests",
+    "ExecutorStats",
+    "ServingExecutor",
+]

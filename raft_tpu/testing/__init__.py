@@ -1,10 +1,13 @@
 """Testing utilities shipped with the library — deterministic fault
 injection (:mod:`raft_tpu.testing.faults`) for exercising the resilience
-layer (``raft_tpu.resilience``) without hardware faults. The reference
-ships its comms self-tests as library code for the same reason: failure
-handling that is only testable in production is not testable.
+layer (``raft_tpu.resilience``) without hardware faults, and the seeded
+open-loop load generator (:mod:`raft_tpu.testing.load`) that drives the
+serving executor (``raft_tpu.serving``) with replayable Poisson arrival
+streams. The reference ships its comms self-tests as library code for
+the same reason: failure handling that is only testable in production
+is not testable.
 """
 
-from raft_tpu.testing import faults
+from raft_tpu.testing import faults, load
 
-__all__ = ["faults"]
+__all__ = ["faults", "load"]

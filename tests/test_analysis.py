@@ -665,6 +665,99 @@ def test_mutation_retrace_suppression_honored():
     assert out == []
 
 
+# -- sync-in-hot-path --------------------------------------------------------
+
+def serving_findings(src, rel="raft_tpu/serving/executor.py"):
+    out = lint_source(textwrap.dedent(src), rel=rel)
+    return [f for f in out if f.rule == "sync-in-hot-path"]
+
+
+def test_sync_in_hot_path_flags_loop_body_syncs():
+    out = serving_findings("""
+        import numpy as np
+        import jax
+
+        def _drain_loop(self):
+            while True:
+                out = self.queue.get()
+                host = np.asarray(out)
+                v = out.item()
+                jax.block_until_ready(out)
+                out.block_until_ready()
+    """)
+    assert len(out) == 4
+    msgs = " ".join(f.message for f in out)
+    assert "np.asarray()" in msgs and ".item()" in msgs
+    assert "jax.block_until_ready()" in msgs
+    assert all("_drain_loop" in f.message for f in out)
+
+
+def test_sync_in_hot_path_outside_loop_clean():
+    # the intended pattern: sync AFTER readiness, outside the loop
+    # (setup/demux), is not a finding even in a serving module
+    out = serving_findings("""
+        import numpy as np
+
+        def _finish(self, winner):
+            host = np.asarray(winner)
+            return host
+
+        def warm(self, q0):
+            self.dispatch(q0).block_until_ready()
+    """)
+    assert out == []
+
+
+def test_sync_in_hot_path_loop_named_function_any_module():
+    # a *_loop / serve* function is a hot path wherever it lives
+    out = serving_findings("""
+        import numpy as np
+
+        def serve_forever(q):
+            for batch in q:
+                np.asarray(batch)
+    """, rel="raft_tpu/comms/frontend.py")
+    assert len(out) == 1 and "serve_forever" in out[0].message
+
+
+def test_sync_in_hot_path_plain_module_function_clean():
+    # same shape, non-serving module, unremarkable name: not a hot path
+    out = serving_findings("""
+        import numpy as np
+
+        def gather(parts):
+            outs = []
+            for p in parts:
+                outs.append(np.asarray(p))
+            return outs
+    """, rel="raft_tpu/spatial/knn.py")
+    assert out == []
+
+
+def test_sync_in_hot_path_numpy_alias_and_while_test():
+    # alias resolution (import numpy as xp) and a sync in the WHILE
+    # TEST itself (runs every iteration) are both caught
+    out = serving_findings("""
+        import numpy as xp
+
+        def _batch_loop(self):
+            while self.flag.item():
+                x = xp.array(self.next())
+    """)
+    assert len(out) == 2
+
+
+def test_sync_in_hot_path_suppression_honored():
+    out = serving_findings("""
+        import numpy as np
+
+        def _drain_loop(self):
+            for fl in self.inflight:
+                host = np.asarray(fl.out)  # jaxlint: disable=sync-in-hot-path
+    """)
+    assert out == []
+
+
 # -- engine: baseline, CLI, self-gate ---------------------------------------
 
 FIXTURE_BAD = textwrap.dedent("""

@@ -1,0 +1,466 @@
+"""Open-loop serving surface (ISSUE 8): shape-bucketed micro-batching,
+the async pipelined executor (coalescing, padding, deadline flush,
+completion demux, shedding), and the deterministic Poisson load
+generator — all on CPU with a tiny index, asserting BEHAVIOR (batching
+and demux correctness, zero recompiles, shed accounting), never QPS.
+The chaos path (mid-stream rank failure + hedge + failover through one
+executor) lives in tests/test_resilience.py next to its fixtures."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.resilience import AdmissionController, HedgePolicy
+from raft_tpu.serving import (
+    BucketSet,
+    ServingExecutor,
+    pack_requests,
+)
+from raft_tpu.serving.batching import PendingRequest
+from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+from raft_tpu.spatial.ann.ivf_flat import (
+    _grouped_impl,
+    ivf_flat_search_grouped,
+)
+from raft_tpu.testing import faults, load
+
+D = 8
+K = 4
+N_PROBES = 4
+BUCKETS = (4, 8)
+
+
+# ----------------------------------------------------------- bucket set
+class TestBucketSet:
+    def test_select_smallest_fitting(self):
+        b = BucketSet.of([8, 4, 16])
+        assert b.sizes == (4, 8, 16)
+        assert b.select(1) == 4
+        assert b.select(4) == 4
+        assert b.select(5) == 8
+        assert b.select(16) == 16
+        # beyond the largest: the largest (caller packs what fits)
+        assert b.select(100) == 16
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BucketSet(())
+        with pytest.raises(ValueError):
+            BucketSet((4, 4))
+        with pytest.raises(ValueError):
+            BucketSet((8, 4))
+        with pytest.raises(ValueError):
+            BucketSet((0,))
+        with pytest.raises(ValueError):
+            BucketSet.of([])
+        with pytest.raises(ValueError):
+            BucketSet((True,))
+
+    def test_pack_whole_requests_only(self):
+        """A request never splits across batches: 3+3 rows into bucket 4
+        packs ONE request (padded), the second stays pending — the
+        bucket-straddling arrival becomes two warmed-shape batches."""
+        buckets = BucketSet.of(BUCKETS)
+
+        def req(m):
+            return PendingRequest(
+                queries=np.ones((m, D), np.float32),
+                future=None, t_arrival=0.0,
+            )
+
+        pending = [req(3), req(3), req(3)]
+        batch, rest = pack_requests(pending, buckets, D)
+        # 9 total rows -> bucket 8 -> two whole requests fit (6 rows)
+        assert batch.bucket == 8 and batch.n_valid == 6
+        assert batch.n_requests == 2 and len(rest) == 1
+        batch2, rest2 = pack_requests(rest, buckets, D)
+        assert batch2.bucket == 4 and batch2.n_valid == 3
+        assert batch2.n_padded == 1 and not rest2
+        # padded rows are zeros
+        np.testing.assert_array_equal(batch2.queries[3], 0.0)
+
+
+# ------------------------------------------------------- load generator
+class TestPoissonLoad:
+    def test_deterministic_and_rate(self):
+        a = load.poisson_arrivals(100.0, 500, seed=7)
+        b = load.poisson_arrivals(100.0, 500, seed=7)
+        np.testing.assert_array_equal(a.times_s, b.times_s)
+        c = load.poisson_arrivals(100.0, 500, seed=8)
+        assert not np.array_equal(a.times_s, c.times_s)
+        # mean gap ~ 1/rate (law of large numbers, generous band)
+        gaps = np.diff(a.times_s)
+        assert 0.5 / 100.0 < gaps.mean() < 2.0 / 100.0
+        assert a.n_requests == 500 and a.n_rows == 500
+
+    def test_size_mix_deterministic(self):
+        s = load.poisson_arrivals(10.0, 200, seed=3, sizes=(1, 8),
+                                  size_weights=(0.75, 0.25))
+        assert set(np.unique(s.sizes)) <= {1, 8}
+        assert s.n_rows == int(s.sizes.sum())
+        s2 = load.poisson_arrivals(10.0, 200, seed=3, sizes=(1, 8),
+                                   size_weights=(0.75, 0.25))
+        np.testing.assert_array_equal(s.sizes, s2.sizes)
+
+    def test_replay_open_loop_never_waits_on_results(self):
+        """Replay with a virtual clock: each submit fires at its
+        scheduled instant; a slow submit makes the NEXT one fire
+        immediately (lag recorded), never re-shapes the offered load."""
+        sched = load.ArrivalSchedule(
+            times_s=np.array([0.0, 0.01, 0.02, 0.03]),
+            sizes=np.ones(4, np.int64),
+        )
+        t = [0.0]
+        calls = []
+
+        def clock():
+            return t[0]
+
+        def sleep(s):
+            t[0] += s
+
+        def submit(i, size):
+            calls.append((i, t[0]))
+            if i == 1:
+                t[0] += 0.05          # submit path stalls past schedule
+            return i
+
+        results, stamps, max_lag = load.replay(
+            sched, submit, clock=clock, sleep=sleep
+        )
+        assert [c[0] for c in calls] == [0, 1, 2, 3]
+        assert calls[1][1] == pytest.approx(0.01)
+        assert calls[2][1] == pytest.approx(0.06)   # fired immediately
+        assert max_lag == pytest.approx(0.04)
+        assert results == [0, 1, 2, 3]
+
+    def test_replay_records_sheds_as_data(self):
+        sched = load.ArrivalSchedule(
+            times_s=np.zeros(3), sizes=np.ones(3, np.int64),
+        )
+
+        def submit(i, size):
+            if i == 1:
+                raise errors.RaftOverloadError("full", retry_after_s=0.1)
+            return i
+
+        results, _, _ = load.replay(
+            sched, submit, clock=lambda: 0.0, sleep=lambda s: None
+        )
+        assert results[0] == 0 and results[2] == 2
+        assert isinstance(results[1], errors.RaftOverloadError)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            load.poisson_arrivals(0.0, 10, seed=0)
+        with pytest.raises(ValueError):
+            load.poisson_arrivals(1.0, 0, seed=0)
+        with pytest.raises(ValueError):
+            load.ArrivalSchedule(
+                times_s=np.array([1.0, 0.5]),
+                sizes=np.ones(2, np.int64),
+            )
+
+
+# --------------------------------------------------------- the executor
+@pytest.fixture(scope="module")
+def tiny_serving():
+    """A tiny warmed IVF-Flat serving setup: per-bucket closures at ONE
+    shared qcap (so per-row results are batch-composition-independent)
+    plus the healthy full-batch reference."""
+    rng = np.random.default_rng(17)
+    x = rng.standard_normal((2048, D)).astype(np.float32)
+    idx = ivf_flat_build(x, IVFFlatParams(n_lists=8, kmeans_n_iters=3,
+                                          seed=2))
+    qcap = 32                     # >= nq of every shape: no probe drops,
+    # so per-row results are batch-composition-independent
+    for b in BUCKETS:
+        idx.warmup(b, k=K, n_probes=N_PROBES, qcap=qcap)
+
+    def dispatch(batch, **_rt):
+        return ivf_flat_search_grouped(
+            idx, batch, K, n_probes=N_PROBES, qcap=qcap,
+        )
+
+    q = rng.standard_normal((32, D)).astype(np.float32)
+    vref, iref = (np.asarray(a) for a in dispatch(jnp.asarray(
+        np.concatenate([q, np.zeros((0, D), np.float32)])[:32]
+    )))
+    # per-row reference at the same qcap, computed bucket-shaped so it
+    # matches whatever batch composition the executor chooses
+    refs = {}
+    for start in range(0, 32):
+        refs[start] = (vref[start], iref[start])
+    return idx, dispatch, q, refs
+
+
+def _check_request(req_rows, result, q, refs):
+    v, i = result
+    assert v.shape == (len(req_rows), K)
+    for out_row, src in enumerate(req_rows):
+        np.testing.assert_array_equal(i[out_row], refs[src][1])
+        np.testing.assert_allclose(v[out_row], refs[src][0], rtol=1e-6)
+
+
+class TestExecutorDemux:
+    def test_mixed_sizes_demux_and_zero_recompiles(self, tiny_serving):
+        """Requests of mixed sizes coalesce into warmed buckets; every
+        caller gets exactly its own rows back; steady state compiles
+        NOTHING new (the cache-size audit — the zero-retrace
+        discipline)."""
+        idx, dispatch, q, refs = tiny_serving
+        warmed = _grouped_impl._cache_size()
+        ex = ServingExecutor(dispatch, BUCKETS, dim=D,
+                             flush_age_s=0.002, max_in_flight=3)
+        reqs = []       # (row indices, future)
+        cursor = 0
+        for m in (1, 3, 2, 4, 1, 1, 8, 2, 3, 1, 4, 2):
+            rows = list(range(cursor, cursor + m))
+            cursor += m
+            if cursor > 32:
+                break
+            reqs.append((rows, ex.submit(q[rows[0]:rows[-1] + 1])))
+        for rows, fut in reqs:
+            _check_request(rows, fut.result(timeout=30), q, refs)
+        st = ex.stats()
+        ex.close()
+        assert st.completed == len(reqs) and st.failed == 0
+        assert st.batches >= 2
+        assert _grouped_impl._cache_size() == warmed, \
+            "open-loop serving must dispatch only warmed bucket shapes"
+
+    def test_smaller_than_smallest_bucket_pads(self, tiny_serving):
+        """A lone 2-row request: padded to the smallest bucket, pad rows
+        dispatched but never surfaced, no new compile."""
+        idx, dispatch, q, refs = tiny_serving
+        warmed = _grouped_impl._cache_size()
+        ex = ServingExecutor(dispatch, BUCKETS, dim=D,
+                             flush_age_s=0.0)        # flush immediately
+        fut = ex.submit(q[5:7])
+        _check_request([5, 6], fut.result(timeout=30), q, refs)
+        st = ex.stats()
+        ex.close()
+        assert st.batches == 1 and st.padded_rows == BUCKETS[0] - 2
+        assert st.flushes_deadline == 1 and st.flushes_full == 0
+        assert _grouped_impl._cache_size() == warmed
+
+    def test_straddling_requests_two_warmed_batches(self, tiny_serving):
+        """Arrivals straddling the largest bucket (3+3+3 rows vs bucket
+        8) become TWO warmed-shape dispatches — whole requests only,
+        zero recompiles."""
+        idx, dispatch, q, refs = tiny_serving
+        warmed = _grouped_impl._cache_size()
+        gate = threading.Event()
+
+        def gated(batch, **rt):
+            gate.wait(10.0)
+            return dispatch(batch)
+
+        ex = ServingExecutor(gated, BUCKETS, dim=D, flush_age_s=0.0)
+        futs = [ex.submit(q[s:s + 3]) for s in (0, 3, 6)]
+        gate.set()
+        for s, fut in zip((0, 3, 6), futs):
+            _check_request([s, s + 1, s + 2], fut.result(timeout=30),
+                           q, refs)
+        st = ex.stats()
+        ex.close()
+        assert st.batches == 2                       # 8-batch + 4-batch
+        assert st.valid_rows == 9 and st.padded_rows == 3
+        assert _grouped_impl._cache_size() == warmed
+
+    def test_deadline_flush_partial_batch(self, tiny_serving):
+        """With a long coalescing window and sub-bucket arrivals, the
+        flush-on-deadline path dispatches a padded partial batch after
+        ``flush_age_s`` — latency stays bounded at light load."""
+        idx, dispatch, q, refs = tiny_serving
+        warmed = _grouped_impl._cache_size()
+        ex = ServingExecutor(dispatch, BUCKETS, dim=D,
+                             flush_age_s=0.05)
+        t0 = time.monotonic()
+        fut = ex.submit(q[9:10])
+        result = fut.result(timeout=30)
+        waited = time.monotonic() - t0
+        _check_request([9], result, q, refs)
+        st = ex.stats()
+        ex.close()
+        assert st.flushes_deadline == 1
+        assert waited >= 0.04            # it DID coalesce-wait first
+        assert _grouped_impl._cache_size() == warmed
+
+    def test_oversized_request_rejected_loudly(self, tiny_serving):
+        idx, dispatch, q, refs = tiny_serving
+        ex = ServingExecutor(dispatch, BUCKETS, dim=D)
+        with pytest.raises(ValueError, match="largest warmed bucket"):
+            ex.submit(np.zeros((BUCKETS[-1] + 1, D), np.float32))
+        with pytest.raises(ValueError, match="expected"):
+            ex.submit(np.zeros((2, D + 1), np.float32))
+        ex.close()
+        with pytest.raises(ValueError, match="closed"):
+            ex.submit(q[:1])
+
+    def test_runtime_inputs_snapshot_per_dispatch(self, tiny_serving):
+        """set_runtime values flow into every LATER dispatch as keyword
+        operands (the failover/health path's transport)."""
+        idx, dispatch, q, refs = tiny_serving
+        seen = []
+
+        def spying(batch, **rt):
+            seen.append(dict(rt))
+            return dispatch(batch)
+
+        ex = ServingExecutor(spying, BUCKETS, dim=D, flush_age_s=0.0,
+                             runtime_inputs={"tag": 1})
+        ex.submit(q[:1]).result(timeout=30)
+        ex.set_runtime(tag=2)
+        ex.submit(q[:1]).result(timeout=30)
+        ex.set_runtime(tag=None)                      # removal
+        ex.submit(q[:1]).result(timeout=30)
+        ex.close()
+        assert seen == [{"tag": 1}, {"tag": 2}, {}]
+
+
+class TestExecutorShedding:
+    def test_queue_bound_sheds_not_collapses(self, tiny_serving):
+        """With dispatch stalled, arrivals beyond the admission queue
+        shed with RaftOverloadError (occupancy-priced retry_after);
+        everything admitted completes once the stall clears."""
+        idx, dispatch, q, refs = tiny_serving
+        gate = threading.Event()
+
+        def gated(batch, **rt):
+            gate.wait(10.0)
+            return dispatch(batch)
+
+        ctrl = AdmissionController(max_concurrent=2, max_queue=4)
+        ex = ServingExecutor(gated, BUCKETS, dim=D, flush_age_s=0.0,
+                             max_in_flight=1, admission=ctrl)
+        futs, sheds = [], 0
+        for i in range(16):
+            try:
+                futs.append((i % 32, ex.submit(q[i % 32:i % 32 + 1])))
+            except errors.RaftOverloadError as e:
+                sheds += 1
+                assert e.retry_after_s is None or e.retry_after_s >= 0
+        gate.set()
+        for src, fut in futs:
+            _check_request([src], fut.result(timeout=30), q, refs)
+        st = ctrl.stats()
+        ex.close()
+        assert sheds > 0 and st.shed_queue == sheds
+        assert st.completed == len(futs)
+        assert st.queue_depth == 0 and st.in_flight == 0
+
+    def test_caller_cancelled_future_does_not_wedge_drain(self,
+                                                          tiny_serving):
+        """A caller cancelling its future (client-side timeout) must
+        not kill the drain thread: the batch demuxes around the
+        cancelled entry and later requests still complete."""
+        idx, dispatch, q, refs = tiny_serving
+        gate = threading.Event()
+
+        def gated(batch, **rt):
+            gate.wait(10.0)
+            return dispatch(batch)
+
+        ex = ServingExecutor(gated, BUCKETS, dim=D, flush_age_s=0.0)
+        f1 = ex.submit(q[:1])
+        f2 = ex.submit(q[1:2])
+        assert f1.cancel()               # still pending: cancel wins
+        gate.set()
+        _check_request([1], f2.result(timeout=30), q, refs)
+        f3 = ex.submit(q[2:3])           # the drain thread survived
+        _check_request([2], f3.result(timeout=30), q, refs)
+        st = ex.stats()
+        ex.close()
+        assert ex._drainer is not None and not ex._drainer.is_alive()
+        assert st.completed == 2 and st.failed == 0
+
+    def test_dispatch_failure_fails_only_its_batch(self, tiny_serving):
+        idx, dispatch, q, refs = tiny_serving
+        calls = []
+
+        def flaky(batch, **rt):
+            calls.append(batch.shape[0])
+            if len(calls) == 1:
+                raise RuntimeError("injected dispatch failure")
+            return dispatch(batch)
+
+        ex = ServingExecutor(flaky, BUCKETS, dim=D, flush_age_s=0.0)
+        f1 = ex.submit(q[:2])
+        with pytest.raises(RuntimeError, match="injected"):
+            f1.result(timeout=30)
+        f2 = ex.submit(q[3:4])
+        _check_request([3], f2.result(timeout=30), q, refs)
+        st = ex.stats()
+        ex.close()
+        assert st.failed == 1 and st.completed == 1
+
+
+class TestExecutorHedge:
+    def test_straggling_batch_hedged_to_backup(self, tiny_serving):
+        """A batch whose primary polls not-ready past the hedge delay is
+        re-dispatched from its HOST copy through the backup closure; the
+        first ready answer is demuxed (identical results)."""
+        idx, dispatch, q, refs = tiny_serving
+        wrapped, audit = faults.inject_straggler(
+            dispatch, every=2, seconds=30.0,
+        )
+        pol = HedgePolicy(default_delay_s=0.01, min_samples=10 ** 6)
+        ex = ServingExecutor(
+            wrapped, BUCKETS, dim=D, flush_age_s=0.0,
+            hedge=pol, backup_dispatch=dispatch,
+        )
+        f1 = ex.submit(q[:2])                 # call 1: fast
+        _check_request([0, 1], f1.result(timeout=30), q, refs)
+        f2 = ex.submit(q[4:6])                # call 2: straggles 30 s
+        _check_request([4, 5], f2.result(timeout=30), q, refs)
+        st = ex.stats()
+        ex.close()
+        assert st.hedged_batches == 1 and st.backup_wins == 1
+        assert pol.hedges == 1 and pol.backup_wins == 1
+
+    def test_backup_requires_hedge_policy(self, tiny_serving):
+        idx, dispatch, q, refs = tiny_serving
+        with pytest.raises(ValueError, match="hedge="):
+            ServingExecutor(dispatch, BUCKETS, dim=D,
+                            backup_dispatch=dispatch)
+
+
+# ----------------------------------------------- open-loop smoke (bench)
+def test_open_loop_row_tiny_config():
+    """The CI-safe open-loop smoke (ISSUE 8 satellite): the bench row's
+    full pipeline — Poisson schedule, executor, saturation probe,
+    offered-load sweep — on a tiny CPU config, asserting SHAPE and
+    accounting, never QPS."""
+    from bench.bench_serving import open_loop_row
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2048, D)).astype(np.float32)
+    idx = ivf_flat_build(x, IVFFlatParams(n_lists=8, kmeans_n_iters=3,
+                                          seed=2))
+    q = rng.standard_normal((32, D)).astype(np.float32)
+
+    def make_run(bucket):
+        qcap = idx.warmup(bucket, k=K, n_probes=N_PROBES)
+
+        def run(qq, qcap=qcap):
+            return ivf_flat_search_grouped(idx, qq, K,
+                                           n_probes=N_PROBES, qcap=qcap)
+        return run
+
+    row = open_loop_row(make_run, q, buckets=BUCKETS, request_size=2,
+                        n_requests=24, chain=(1, 3), escalate=0,
+                        flush_age_s=0.001, fracs=(0.5, 0.95),
+                        min_duration_s=0.0)   # tiny fixed count on CI
+    assert row["scenario"] == "open_loop"
+    assert "error" not in row, row
+    assert row["buckets"] == list(BUCKETS)
+    assert row["program_qps"] > 0 and row["saturation_qps"] > 0
+    assert row["qps_ratio_vs_program"] > 0
+    for tag in ("50", "95"):
+        assert row[f"p50_ms_{tag}"] > 0
+        assert row[f"p99_ms_{tag}"] >= row[f"p50_ms_{tag}"]

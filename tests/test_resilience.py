@@ -748,6 +748,116 @@ def test_failover_flip_zero_retrace(comms8, dataset, replicated_flat,
         )
 
 
+def test_open_loop_executor_failover_chaos(comms8, dataset,
+                                           replicated_flat, monkeypatch):
+    """ISSUE 8 chaos acceptance: ONE open-loop executor serves a
+    request stream through a mid-stream rank failure with R=2 — the
+    hedge covers the straggling batches, the FailoverPlan route flows
+    in as a runtime input, every answer stays bit-identical to the
+    healthy mesh at coverage 1.0, and the compiled program never
+    retraces."""
+    from raft_tpu.comms import mnmg_ivf_flat as mod
+    from raft_tpu.serving import ServingExecutor
+
+    _, q = dataset                                   # (12, 16) queries
+    qcap = q.shape[0]
+    buckets = (4, 8)
+    created = []
+    orig = mod._cached_search
+
+    def recording(*a, **k):
+        fn = orig(*a, **k)
+        created.append(fn)
+        return fn
+
+    monkeypatch.setattr(mod, "_cached_search", recording)
+    placement = ReplicaPlacement.of_index(replicated_flat)
+    health = ShardHealth(8)
+
+    def run(qq, shard_mask=None, failover=None):
+        return mod.mnmg_ivf_flat_search(
+            comms8, replicated_flat, qq, K, n_probes=8, qcap=qcap,
+            shard_mask=shard_mask if shard_mask is not None
+            else np.ones(8, np.int32),
+            failover=failover,
+        )
+
+    # healthy reference + warm both bucket shapes BEFORE the audit mark
+    plan0 = FailoverPlan.from_health(placement, health)
+    ref = run(jnp.asarray(q), shard_mask=health.mask(), failover=plan0)
+    vref, iref = np.asarray(ref.distances), np.asarray(ref.ids)
+    for b in buckets:
+        jax.block_until_ready(run(
+            jnp.zeros((b, q.shape[1]), jnp.float32),
+            shard_mask=health.mask(), failover=plan0,
+        ))
+    fn = created[0]
+    size0 = fn._cache_size()
+
+    straggler_s = 1.0
+    primary, audit = faults.inject_straggler(run, every=3,
+                                             seconds=straggler_s)
+    ex = ServingExecutor(
+        primary, buckets, dim=q.shape[1], flush_age_s=0.0,
+        max_in_flight=2, hedge=0.02, backup_dispatch=run,
+        runtime_inputs={"shard_mask": health.mask(), "failover": plan0},
+    )
+    lat_ms = []
+    results = []
+
+    def drain(futs):
+        for rows, fut, t0 in futs:
+            res = fut.result(timeout=60)
+            lat_ms.append((time.monotonic() - t0) * 1e3)
+            results.append((rows, res))
+
+    def submit_wave():
+        futs = []
+        for start, m in ((0, 3), (3, 2), (5, 3), (8, 4), (0, 8), (8, 2)):
+            futs.append((
+                list(range(start, start + m)),
+                ex.submit(q[start:start + m]),
+                time.monotonic(),
+            ))
+        return futs
+
+    drain(submit_wave())                              # healthy traffic
+    # rank 3 dies MID-STREAM: route its shard to the replica via the
+    # executor's runtime inputs — later dispatches pick it up, nothing
+    # recompiles
+    health.mark_down(3)
+    plan = FailoverPlan.from_health(placement, health)
+    assert plan.fully_covered
+    ex.set_runtime(shard_mask=health.mask(), failover=plan)
+    drain(submit_wave())                              # degraded traffic
+    # rank 3 heals; primary routing resumes
+    health.mark_up(3)
+    ex.set_runtime(shard_mask=health.mask(),
+                   failover=FailoverPlan.from_health(placement, health))
+    drain(submit_wave())
+    st = ex.stats()
+    ex.close()
+
+    assert st.completed == len(results) and st.failed == 0
+    # hedge engaged on the injected stragglers (every 3rd batch)
+    assert st.hedged_batches >= 1 and st.backup_wins >= 1
+    # bounded tail THROUGH the failure: the straggling batches resolve
+    # via the backup at ~hedge_delay + service, well under the 1 s
+    # straggle the unhedged path would eat
+    assert max(lat_ms) < 0.9 * straggler_s * 1e3, max(lat_ms)
+    # every answer bit-identical to the healthy mesh at coverage 1.0
+    for rows, res in results:
+        np.testing.assert_array_equal(np.asarray(res.coverage), 1.0)
+        assert bool(np.asarray(res.row_valid).all())
+        np.testing.assert_array_equal(res.ids, iref[rows])
+        np.testing.assert_array_equal(res.distances, vref[rows])
+    # zero retraces across warm → fail → failover → heal, incl. hedges
+    assert all(f is fn for f in created), \
+        "the open-loop stream must reuse the cached program object"
+    assert fn._cache_size() == size0, \
+        "health/failover flips through the executor must not retrace"
+
+
 def test_failover_requires_shard_mask(comms8, dataset, replicated_flat):
     _, q = dataset
     plan = FailoverPlan.from_health(
@@ -1082,6 +1192,118 @@ class TestAdmissionControl:
                     pass  # pragma: no cover
         assert ei.value.retry_after_s is not None
         assert ei.value.retry_after_s > 0.0
+
+    def test_retry_after_occupancy_floors_stale_ewma(self):
+        """ISSUE 8 satellite regression: the service-time EWMA only
+        moves on COMPLETIONS, so a burst after an idle stretch used to
+        price retry_after_s from stale history while the in-flight
+        occupancy already showed service had slowed. The age of the
+        oldest in-flight request must floor the estimate (injectable
+        clock, fully deterministic)."""
+        t = [0.0]
+        ctrl = AdmissionController(max_concurrent=1, max_queue=1,
+                                   clock=lambda: t[0])
+        # one fast completion seeds a tiny (soon stale) EWMA
+        with ctrl.admit():
+            t[0] += 0.001
+        # a request enters service... and runs for 10 s (the regression
+        # scenario: service slowed, nothing has completed since)
+        ctrl.enqueue()
+        ticket = ctrl.begin_service()
+        t[0] += 10.0
+        ctrl.enqueue()                        # fills the queue (1/1)
+        with pytest.raises(errors.RaftOverloadError) as ei:
+            ctrl.enqueue()                    # burst arrival: shed
+        # priced from the 10 s occupancy evidence, NOT the 1 ms EWMA:
+        # (1 waiter + 1 in flight) * max(ewma, oldest in-flight age)
+        assert ei.value.retry_after_s == pytest.approx(20.0)
+        # completion folds the observed slow service into the EWMA
+        ctrl.finish_service(ticket)
+        assert ctrl._service_ewma_s == pytest.approx(
+            0.8 * 0.001 + 0.2 * 10.0
+        )
+        st = ctrl.stats()
+        assert st.in_flight == 0 and st.queue_depth == 1
+        ctrl.cancel_queued()
+        assert ctrl.stats().queue_depth == 0
+
+    def test_async_triple_counters_and_shed(self):
+        """The executor's non-blocking path: enqueue never waits,
+        begin/finish move the gauges, sheds beyond the TOTAL capacity
+        (queued + in service vs max_queue + max_concurrent)."""
+        ctrl = AdmissionController(max_concurrent=2, max_queue=0)
+        ctrl.enqueue()
+        ctrl.enqueue()
+        with pytest.raises(errors.RaftOverloadError):
+            ctrl.enqueue()                    # 2 outstanding == capacity
+        tk = ctrl.begin_service(2)            # one micro-batch of 2
+        st = ctrl.stats()
+        assert st.queue_depth == 0 and st.in_flight == 2
+        assert st.admitted == 2 and st.shed_queue == 1
+        with pytest.raises(errors.RaftOverloadError):
+            ctrl.enqueue()                    # in-service still counts
+        ctrl.finish_service(tk)
+        ctrl.enqueue()                        # capacity freed
+        ctrl.cancel_queued()
+        st = ctrl.stats()
+        assert st.in_flight == 0 and st.completed == 2
+        with pytest.raises(ValueError):
+            ctrl.begin_service(1)             # nothing queued
+
+    def test_enqueue_idle_default_controller_admits(self):
+        """A default controller (max_concurrent=1, max_queue=0) on an
+        IDLE server must admit the async path's first request — the
+        bound is total capacity, not raw queue depth (a free slot would
+        have absorbed the request immediately in the blocking world)."""
+        ctrl = AdmissionController()
+        ctrl.enqueue()                        # no shed
+        tk = ctrl.begin_service()
+        with pytest.raises(errors.RaftOverloadError):
+            ctrl.enqueue()                    # now genuinely full
+        ctrl.finish_service(tk)
+        ctrl.enqueue()                        # and free again
+        ctrl.cancel_queued()
+
+    def test_occupancy_floor_amortized_over_batch_ticket(self):
+        """A service ticket covers a whole micro-batch: the occupancy
+        floor must price PER REQUEST (batch age / n), not charge every
+        queued request the full batch age (injectable clock)."""
+        t = [0.0]
+        ctrl = AdmissionController(max_concurrent=8, max_queue=8,
+                                   clock=lambda: t[0])
+        for _ in range(4):
+            ctrl.enqueue()
+        ctrl.begin_service(4)                 # one batch of 4
+        t[0] += 0.08                          # in service 80 ms
+        for _ in range(12):
+            ctrl.enqueue()                    # fills capacity (16)
+        with pytest.raises(errors.RaftOverloadError) as ei:
+            ctrl.enqueue()
+        # (12 waiters + 4 in flight) * (0.08 / 4) per request — NOT
+        # * 0.08, which would price a ~0.16 s backlog at 1.28 s
+        assert ei.value.retry_after_s == pytest.approx(16 * 0.02)
+
+    def test_abort_service_frees_slot_without_ewma_or_completed(self):
+        """A crashed dispatch releases its slot but is NOT service
+        evidence: the near-zero held time must not drag the EWMA toward
+        0 (underpricing every later shed) and its failed requests must
+        not count as completed (injectable clock)."""
+        t = [0.0]
+        ctrl = AdmissionController(max_concurrent=2, max_queue=4,
+                                   clock=lambda: t[0])
+        # a real completion seeds the EWMA at 2 s
+        ctrl.enqueue()
+        tk = ctrl.begin_service()
+        t[0] += 2.0
+        ctrl.finish_service(tk)
+        assert ctrl._service_ewma_s == pytest.approx(2.0)
+        # a dispatch that fails immediately aborts its ticket
+        ctrl.enqueue()
+        tk2 = ctrl.begin_service()
+        ctrl.abort_service(tk2)
+        st = ctrl.stats()
+        assert st.in_flight == 0 and st.completed == 1
+        assert ctrl._service_ewma_s == pytest.approx(2.0)  # untouched
 
     def test_validation(self):
         with pytest.raises(ValueError):

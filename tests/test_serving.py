@@ -377,7 +377,7 @@ def test_serving_latency_rows_tiny_config():
     out = serving_latency_rows(
         n=8192, d=8, k=4, n_probes=4, n_lists=8, nqs=(1, 4),
         engines=("ivf_flat",), chain=(1, 3), escalate=0,
-        hedged=False, overload=False, mixed=False,
+        hedged=False, overload=False, mixed=False, open_loop=False,
     )
     assert out["unit"] == "ms"
     assert [r["nq"] for r in out["rows"]] == [1, 4]
@@ -494,6 +494,121 @@ def test_round6_bench_line_parses(benchtop_module=None):
     vals = [e.get("value") for e in parsed["extras"]
             if "value" in e]
     assert vals[:8] == [10000.0 + i for i in range(8)]
+
+
+def test_retired_shard_keys_never_print(benchtop_module=None):
+    """ISSUE 8 satellite: the modeled-projection keys retired in PR 4
+    (``probe_global_ms`` / ``projected_100m_qps`` / ``merge8_ms``) were
+    still showing in BENCH_r05's shard rows. They must be stripped from
+    every printed row — and from prior-round rows before vs_prev
+    stamping — so a stale artifact can never resurrect them."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_retired", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    row = {
+        "metric": "mnmg_ivf_flat_shard_12500000x96_q16384_k10_p16",
+        "value": 50620.9, "unit": "QPS", "spread": 0.014,
+        "merge8_ms": 0.45, "probe_global_ms": 50.45,
+        "projected_100m_qps": 93002.5, "qcap8_qps": 130789.3,
+        "vs_prev_projected_100m_qps": 1.01,
+        "extras": [{"metric": "e", "value": 1.0,
+                    "probe_global_ms": 50.19}],
+    }
+    c = benchtop._compact(row)
+    for key in ("probe_global_ms", "projected_100m_qps", "merge8_ms",
+                "vs_prev_projected_100m_qps"):
+        assert key not in c, key
+    assert "probe_global_ms" not in c["extras"][0]
+    assert c["qcap8_qps"] == 130789.3          # measured keys survive
+    # the retired keys are not in the print whitelist either
+    for key in benchtop._RETIRED_KEYS:
+        assert key not in benchtop._PRINT_KEYS
+
+
+def test_round8_bench_line_parses_with_open_loop():
+    """ISSUE 8 satellite (the _fit_line parse/cap test extended): the
+    round-8 artifact shape — every prior row PLUS the open-loop
+    executor row — must print as a line that json.loads-round-trips
+    under the 1800-char driver cap, with the open-loop acceptance keys
+    (saturation vs program ratio, p99 at 80/95% of saturation)
+    surviving every trim stage."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r8", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    serving_rows = [
+        {"engine": e, "nq": nq, "p50_ms": 1.2345, "spread": 0.08,
+         "repeats": 5, "qcap": 24}
+        for e in ("fused_knn", "ivf_flat", "ivf_pq")
+        for nq in (1, 128, 1024)
+    ] + [
+        {"engine": "ivf_flat", "scenario": "hedged_straggler", "nq": 128,
+         "p50_ms": 1.9, "p99_ms": 31.4, "hedged_p99_ms": 6.2,
+         "n_requests": 64},
+        {"engine": "ivf_flat", "scenario": "overload_2x", "nq": 128,
+         "p50_ms": 2.0, "shed_rate": 0.47, "p99_ms": 22.7},
+        {"engine": "ivf_flat", "scenario": "mixed_ingest", "nq": 128,
+         "ingest_batch": 256, "qcap": 24, "frozen_qps": 52000.0,
+         "ingest_qps": 310000.0, "mixed_search_qps": 45000.0,
+         "spread": 0.06, "repeats": 5, "escalations": 1,
+         "qps_ratio_vs_frozen": 0.865, "upsert_visible_ms": 4.2,
+         "delete_masked_ms": 2.9},
+        {"engine": "ivf_flat", "scenario": "open_loop", "nq": 1024,
+         "program_qps": 610000.0, "saturation_qps": 512000.0,
+         "qps_ratio_vs_program": 0.839, "spread": 0.04, "repeats": 5,
+         "p50_ms_50": 2.4, "p99_ms_50": 5.1, "p50_ms_80": 3.0,
+         "p99_ms_80": 7.9, "p50_ms_95": 4.2, "p99_ms_95": 14.6,
+         "shed_rate_95": 0.012, "max_in_flight": 4,
+         "request_size": 16, "n_requests": 256},
+    ]
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01,
+         "vs_prev_qcap8_qps": 0.99, "vs_prev_build_warm_s": 1.0}
+        for i in range(8)
+    ] + [
+        {"metric": "serving_p50_500000x96_k10_p16", "unit": "ms",
+         "rows": serving_rows},
+        {"metric": "warm_start_build_500000x96", "unit": "s",
+         "value": 3.1, "build_warm_s": 1.9, "within_2x_warm": True},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # the open-loop acceptance keys survive whatever trimming was
+    # needed — they are not in _TRIM_ORDER, and only fall with "rows"
+    if any("rows" in e for e in parsed.get("extras", [])):
+        srv = next(e for e in parsed["extras"] if "rows" in e)
+        orow = next(r for r in srv["rows"]
+                    if r.get("scenario") == "open_loop")
+        assert orow["qps_ratio_vs_program"] == 0.839
+        assert orow["p99_ms_95"] == 14.6 and orow["p99_ms_80"] == 7.9
+        assert "saturation_qps" in orow and "program_qps" in orow
 
 
 def test_mixed_ingest_row_tiny_config():
