@@ -957,6 +957,20 @@ def extra_serving():
     return serving_latency_rows()
 
 
+def extra_mnmg_cross_host():
+    """The cross-host serving row (ISSUE 9, docs/multihost.md): host-sim
+    2x4 hierarchical ICI x DCN merge vs the flat 1x8 deployment-width
+    allgather on identical shards — e2e QPS of both fused programs, the
+    DCN byte model per query (the >= 4x acceptance), standalone
+    merge-tail latency, and the whole-host die -> failover -> heal flip
+    audited for zero retraces with coverage 1.0 and bit-identical
+    results at R=2 host-aware placement. Harness:
+    bench/bench_mnmg.py ``cross_host_row``."""
+    from bench.bench_mnmg import cross_host_row
+
+    return cross_host_row()
+
+
 _EXTRAS = {
     "big_knn": extra_big_knn,
     "kmeans": extra_kmeans,
@@ -965,6 +979,7 @@ _EXTRAS = {
     "mnmg_ivf_pq": extra_mnmg_ivf_pq,
     "mnmg_shard_100m": extra_mnmg_shard_100m,
     "mnmg_shard_100m_flat": extra_mnmg_shard_100m_flat,
+    "mnmg_cross_host": extra_mnmg_cross_host,
     "serving": extra_serving,
     "warm_start": extra_warm_start,
 }
@@ -973,6 +988,7 @@ _EXTRAS = {
 _EXTRA_TIMEOUT = {
     "mnmg_shard_100m": 2400, "ivf_pq_10m": 1800,
     "mnmg_shard_100m_flat": 2400, "serving": 2400, "warm_start": 2000,
+    "mnmg_cross_host": 1800,
 }
 
 
@@ -1050,7 +1066,8 @@ def _load_prev_bench():
 # because vs_prev covered only each row's primary value)
 _COMPANIONS = ("bf16_iters_per_s", "f32_highest_gflops",
                "brute_force_same_shape_qps", "build_warm_s",
-               "qcap8_qps", "measured_chip_qps", "sharded_e2e_qps")
+               "qcap8_qps", "measured_chip_qps", "sharded_e2e_qps",
+               "flat_e2e_qps")
 
 
 def _stamp_vs_prev(row, prev):
@@ -1103,6 +1120,14 @@ _PRINT_KEYS = {
     "program_qps", "saturation_qps", "qps_ratio_vs_program",
     "p50_ms_50", "p99_ms_50", "p50_ms_80", "p99_ms_80",
     "p50_ms_95", "p99_ms_95", "shed_rate_95",
+    # the cross-host serving row (ISSUE 9, docs/multihost.md): host-sim
+    # hierarchical vs flat e2e QPS, the DCN byte model (the >= 4x
+    # acceptance), merge-tail latency, and the zero-retrace host-flip
+    # audit
+    "flat_e2e_qps", "qps_ratio_vs_flat", "wire",
+    "dcn_bytes_per_query", "dcn_bytes_ratio",
+    "merge_ms_hier", "merge_ms_flat",
+    "health_flip_retraces", "coverage_host_down", "host_down_bitident",
 }
 
 
@@ -1123,6 +1148,8 @@ _TRIM_ORDER = (
     "build_warm_s",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
+    "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",
+    "flat_e2e_qps",
     "f32_highest_gflops", "bf16_iters_per_s", "measured_chip_qps",
     "brute_force_same_shape_qps", "qcap8_qps", "build_s",
 )
@@ -1194,7 +1221,8 @@ def _compact(row):
         if key not in _PRINT_KEYS and not key.startswith("vs_prev"):
             continue
         if isinstance(v, str) and key not in (
-            "metric", "unit", "error", "engine", "scenario", "adc_engine"
+            "metric", "unit", "error", "engine", "scenario",
+            "adc_engine", "wire"
         ):
             continue
         if isinstance(v, list) and v and isinstance(v[0], dict):

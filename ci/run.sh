@@ -61,6 +61,21 @@ run_tests() {
         python -m pytest tests/ -q
 }
 
+run_multihost_smoke() {
+    # CPU-only 2-process host-sim smoke (ISSUE 9): the multiproc
+    # rendezvous workers build the (num_procs, 2) HierarchicalComms
+    # whose outer (dcn) axis IS the real gloo process boundary, run the
+    # two-stage hierarchical merge end-to-end, and assert bit-identity
+    # vs the flat single-host program — so the DCN code path is
+    # exercised on every CI run, not only on real multi-host hardware.
+    # Runs BEFORE the full suite to fail fast (the full run repeats it
+    # under the same shared-deadline supervision; the workers' own
+    # bring-up retry handles loaded-host flake).
+    echo "== multi-host smoke (2-process host-sim over gloo) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_multiproc.py -q \
+        -k "hierarchical"
+}
+
 run_x64() {
     # float64 pass in its OWN process — x64 is process-global config
     # (the reference's double-instantiation niche, cpp/src/ *_d builds)
@@ -82,7 +97,10 @@ case "$stage" in
     test) run_tests ;;
     x64) run_x64 ;;
     docs) run_docs ;;
-    all) run_style; run_install_check; run_docs; run_x64; run_tests ;;
-    *) echo "unknown stage: $stage (style|test|x64|docs|all)"; exit 2 ;;
+    multihost) run_multihost_smoke ;;
+    all) run_style; run_install_check; run_docs; run_x64; \
+         run_multihost_smoke; run_tests ;;
+    *) echo "unknown stage: $stage (style|test|x64|docs|multihost|all)"
+       exit 2 ;;
 esac
 echo "CI: OK"

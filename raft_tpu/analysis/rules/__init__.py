@@ -24,6 +24,7 @@ def _collect() -> List[Rule]:
     from raft_tpu.analysis.rules import (
         adc_gather,
         api_compat,
+        dcn_wide_collective,
         mutation_retrace,
         prng_discipline,
         recompile_hazard,
@@ -35,7 +36,8 @@ def _collect() -> List[Rule]:
     out: List[Rule] = []
     for mod in (api_compat, tracer_safety, recompile_hazard,
                 x64_hygiene, prng_discipline, adc_gather,
-                mutation_retrace, sync_in_hot_path):
+                mutation_retrace, sync_in_hot_path,
+                dcn_wide_collective):
         out.extend(mod.RULES)
     return out
 

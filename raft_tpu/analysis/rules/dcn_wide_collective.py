@@ -1,0 +1,137 @@
+"""Rule ``dcn-wide-collective``: a full-width collective spanning a DCN
+(outer) mesh axis inside a traced serving-path body.
+
+The whole cross-host serving budget is DCN bytes (docs/multihost.md
+"Byte accounting"): ICI moves ~10-100x more bytes per second than the
+links between hosts, so one collective that ships full per-chip payloads
+across the dcn axis at deployment width erases the win the hierarchical
+two-stage structure bought — every chip's uncompressed part crosses
+every host boundary, exactly the flat-allgather shape
+``_merge_across_shards`` exists to avoid on 2-level meshes. The hazard
+is silent: the program is correct, compiles, and passes every
+bit-identity test; only the wire meter notices.
+
+Flagged — inside a traced body (``jit``/``shard_map``/``scan``/... per
+:mod:`raft_tpu.analysis.facts`):
+
+* ``lax.all_gather`` / ``lax.psum`` / ``lax.pmean`` / ``lax.pmax`` /
+  ``lax.pmin`` / ``lax.psum_scatter`` / ``lax.all_to_all`` whose axis
+  argument is a
+  LITERAL tuple/list naming a dcn-ish outer axis (``dcn`` / ``outer`` /
+  ``hosts``) TOGETHER with at least one other axis — the one-collective
+  -over-both-levels spelling. An inner-axis pre-reduction is available
+  by construction (the other named axis IS the inner one): restructure
+  as inner reduce-scatter -> dcn collective on 1/inner_size of the
+  bytes -> inner allgather
+  (:meth:`~raft_tpu.comms.comms.HierarchicalComms.
+  hierarchical_allreduce`), or for top-k merges the two-stage
+  compressed-wire tail
+  (:func:`raft_tpu.comms.multihost.hierarchical_merge_select_k`).
+
+A collective over the dcn axis ALONE is not flagged: that is the
+hierarchy's own DCN stage (it runs after the inner pre-reduction and
+moves the already-shrunk payload). Same for single-axis inner
+collectives. Intentional full-width collectives — a control-plane
+barrier, a tiny scalar psum — carry
+``# jaxlint: disable=dcn-wide-collective`` on the line (or live in
+ci/checks/jaxlint_baseline.json).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from raft_tpu.analysis.rules import Rule
+
+# collectives that move O(payload) bytes over every axis they name
+_WIDE_COLLECTIVES = {
+    "all_gather", "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_to_all",
+}
+# outer-axis spellings used for the cross-host level (comms.py builds
+# the 2-level mesh as axes=("dcn", "ici"); "outer"/"hosts" cover ad-hoc
+# meshes in tests and benches)
+_DCN_NAMES = {"dcn", "outer", "hosts", "host"}
+
+
+def _axis_names(node: ast.AST) -> Optional[list]:
+    """The literal axis-name list of a tuple/list AST node, or None when
+    any element is not a string constant (dynamic axes are out of a
+    lexical linter's reach — the baseline absorbs those)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    names = []
+    for el in node.elts:
+        if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+            return None
+        names.append(el.value)
+    return names
+
+
+class DcnWideCollectiveRule(Rule):
+    name = "dcn-wide-collective"
+    description = (
+        "full-width collective over a dcn (outer) mesh axis in a traced "
+        "body — pre-reduce over the inner axis first "
+        "(hierarchical_allreduce / hierarchical_merge_select_k)"
+    )
+
+    def _axis_arg(self, ctx, call: ast.Call) -> Optional[ast.AST]:
+        """The axis-name argument of a lax collective call: the second
+        positional, or the ``axis_name=`` keyword."""
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        if len(call.args) >= 2:
+            return call.args[1]
+        return None
+
+    def _wide_dcn_call(self, ctx, call: ast.Call) -> Optional[str]:
+        d = ctx.facts.dotted(call.func)
+        if d is None:
+            return None
+        tail = d.split(".")[-1]
+        if tail not in _WIDE_COLLECTIVES or "lax" not in d.split("."):
+            return None
+        axis = self._axis_arg(ctx, call)
+        if axis is None:
+            return None
+        names = _axis_names(axis)
+        if names is None or len(names) < 2:
+            return None
+        dcn = [n for n in names if n.lower() in _DCN_NAMES]
+        rest = [n for n in names if n.lower() not in _DCN_NAMES]
+        if not dcn or not rest:
+            return None
+        return (
+            f"lax.{tail} over {tuple(names)} ships full per-chip "
+            f"payloads across the {dcn[0]!r} (DCN) axis at deployment "
+            f"width"
+        )
+
+    def check(self, ctx) -> Iterator:
+        traced_nodes = set()
+        for fn in ctx.facts.traced:
+            traced_nodes.update(
+                id(n) for n in ctx.facts.traced_body_nodes(fn)
+            )
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if id(node) not in traced_nodes:
+                continue
+            what = self._wide_dcn_call(ctx, node)
+            if what is None:
+                continue
+            yield ctx.finding(
+                self.name, node,
+                f"{what} — one such collective erases the hierarchical "
+                "merge's DCN saving; pre-reduce over the inner (ICI) "
+                "axis first: hierarchical_allreduce for reductions, "
+                "hierarchical_merge_select_k for top-k merges, or "
+                "suppress if the payload is a scalar/control barrier",
+            )
+
+
+RULES = [DcnWideCollectiveRule()]

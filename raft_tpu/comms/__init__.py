@@ -39,6 +39,13 @@ from raft_tpu.comms.mnmg_ivf_flat import (
     mnmg_ivf_flat_build_distributed,
     mnmg_ivf_flat_search,
 )
+from raft_tpu.comms.multihost import (
+    comms_levels,
+    dcn_merge_accounting,
+    hierarchical_merge_select_k,
+    host_aware_offset,
+    host_rank_mask,
+)
 from raft_tpu.comms.mnmg_mutation import (
     MnmgMutableIndex,
     MnmgMutationState,
@@ -73,6 +80,11 @@ __all__ = [
     "mnmg_ivf_flat_build",
     "mnmg_ivf_flat_build_distributed",
     "mnmg_ivf_flat_search",
+    "comms_levels",
+    "dcn_merge_accounting",
+    "hierarchical_merge_select_k",
+    "host_aware_offset",
+    "host_rank_mask",
     "place_index",
     "recover_rank",
     "replicate_index",

@@ -436,6 +436,26 @@ class HierarchicalComms(Comms):
         self.axes = tuple(axes)
         self.axis = self.axes  # collectives over BOTH levels by default
 
+    @property
+    def inner_size(self) -> int:
+        """Chips per slice (the ICI width — one host's mesh)."""
+        return int(self.mesh.shape[self.axes[1]])
+
+    @property
+    def outer_size(self) -> int:
+        """Slices in the mesh (the DCN width — the host count)."""
+        return int(self.mesh.shape[self.axes[0]])
+
+    def host_of(self, rank: int) -> int:
+        """The slice (host) holding flattened rank ``rank`` — ranks
+        number row-major over (outer, inner), matching the slab layout
+        of ``P((outer, inner), ...)`` sharded arrays."""
+        errors.expects(
+            0 <= rank < self.size,
+            "rank %d out of range [0, %d)", rank, self.size,
+        )
+        return rank // self.inner_size
+
     def inner_comms(self) -> AxisComms:
         """Collectives within a slice (ICI-routed)."""
         return AxisComms(self.axes[1])
@@ -455,18 +475,24 @@ class HierarchicalComms(Comms):
         slices (DCN moves only 1/inner_size of the bytes), allgather the
         result back within the slice — the structure NCCL's tree/hierarchy
         algorithms use across nodes. Call inside shard_map over the 2D
-        mesh; requires x.shape[0] divisible by the inner size.
+        mesh. A leading dim not divisible by the inner size is padded
+        with zeros for the reduce-scatter and sliced back after the
+        allgather (the old hard precondition turned every odd-shaped
+        payload into a caller-side pad dance).
         """
         inner, outer = self.inner_comms(), self.outer_comms()
-        inner_size = self.mesh.shape[self.axes[1]]
-        errors.expects(
-            x.shape[0] % inner_size == 0,
-            "hierarchical_allreduce: leading dim %d not divisible by the "
-            "inner (slice) size %d", x.shape[0], inner_size,
-        )
+        inner_size = self.inner_size
+        n0 = x.shape[0]
+        rem = n0 % inner_size
+        if rem:
+            # zero rows are sum-neutral; they come back as garbage-free
+            # zero rows and are sliced off below
+            pad = [(0, inner_size - rem)] + [(0, 0)] * (x.ndim - 1)
+            x = jnp.pad(x, pad)
         shard = inner.reducescatter(x, tiled=True)
         shard = outer.allreduce(shard)
-        return inner.allgather(shard, tiled=True)
+        out = inner.allgather(shard, tiled=True)
+        return out[:n0] if rem else out
 
 
 def build_comms(devices=None, axis: str = "ranks") -> Comms:

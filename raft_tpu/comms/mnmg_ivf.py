@@ -42,7 +42,13 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu import compat, errors
-from raft_tpu.comms.comms import Comms
+from raft_tpu.comms.comms import AxisComms, Comms
+from raft_tpu.comms.multihost import (
+    comms_levels,
+    hier_axes,
+    hierarchical_merge_select_k,
+    host_aware_offset,
+)
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.resilience.degraded import (
     PartialSearchResult,
@@ -134,7 +140,7 @@ class MnmgIVFPQIndex:
                shard_mask=None, failover=None, overprobe: float = 2.0,
                merge_ways: typing.Optional[int] = None,
                use_pallas: typing.Optional[bool] = None,
-               mutation=None) -> int:
+               mutation=None, wire: str = "bf16") -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches: one all-zeros batch runs through
         :func:`mnmg_ivf_pq_search` and is blocked on, so the first real
@@ -162,7 +168,7 @@ class MnmgIVFPQIndex:
             donate_queries=donate_queries, shard_mask=shard_mask,
             failover=failover, overprobe=overprobe,
             merge_ways=merge_ways, use_pallas=use_pallas,
-            mutation=mutation,
+            mutation=mutation, wire=wire,
         )
         jax.block_until_ready(out)
         return qc
@@ -965,8 +971,20 @@ def place_index(comms: Comms, index, *,
     loss (docs/robustness.md "Replication & failover"); ``None``
     preserves the index's current replication across the placement.
     ``replica_offset`` overrides the stripe offset (default
-    ``max(1, P // R)``)."""
+    ``max(1, P // R)``; on a :class:`~raft_tpu.comms.comms.
+    HierarchicalComms` with R ≤ the host count the default is the
+    HOST-AWARE stripe instead — :func:`raft_tpu.comms.multihost.
+    host_aware_offset` steps copies by whole hosts, so a whole dead
+    host still leaves every shard a live copy — docs/multihost.md
+    "Host-aware placement")."""
     n_ranks = index.sorted_ids.shape[0]
+    if replica_offset is None and replication is not None \
+            and int(replication) > 1:
+        n_hosts, inner_width = comms_levels(comms)
+        if 1 < n_hosts and int(replication) <= n_hosts:
+            replica_offset = host_aware_offset(
+                comms.size, inner_width, int(replication)
+            )
     cur_r = int(getattr(index, "replication", 1) or 1)
     cur_off = int(getattr(index, "replica_offset", 1) or 1)
     want_r = cur_r if replication is None else int(replication)
@@ -1113,6 +1131,38 @@ def _merge_local_delta(qf, vals, gids, dvl, dil, k, rank, nl_pad,
     )
 
 
+def _merge_across_shards(ax, hier, vals, gids, k, merge_ways, wire):
+    """The in-program cross-shard merge tail shared by both engine
+    bodies (device-side, inside shard_map).
+
+    1-level mesh (``hier=None``): the flat deployment-width allgather +
+    ``merge_parts_select_k`` — unchanged from the single-host tier.
+
+    2-level mesh: the hierarchical ICI × DCN merge (docs/multihost.md):
+    the flat stage runs at ICI width WITHIN each slice (``merge_ways``
+    pads it to the per-host deployment chip count, exactly as before),
+    then only each slice's top-k crosses hosts in the compressed wire
+    format (:func:`raft_tpu.comms.multihost.hierarchical_merge_select_k`
+    — bf16 values + int32 ids, f32 rerank tail). The DCN exchange is
+    part of the one fused dispatch, so the ServingExecutor's in-flight
+    window pipelines it against the next micro-batch's shard compute.
+    """
+    if hier is None:
+        pd = ax.allgather(vals)                          # (P, nq, k)
+        pi = ax.allgather(gids)
+        md, mi = merge_parts_select_k(pd, pi, k, ways=merge_ways)
+    else:
+        outer_ax, inner_ax = hier[0], hier[1]
+        inner = AxisComms(inner_ax)
+        pd = inner.allgather(vals)                       # (I, nq, k)
+        pi = inner.allgather(gids)
+        sv, si = merge_parts_select_k(pd, pi, k, ways=merge_ways)
+        md, mi = hierarchical_merge_select_k(
+            AxisComms(outer_ax), sv, si, k, wire=wire or "bf16"
+        )
+    return md, jnp.where(jnp.isfinite(md), mi, -1)
+
+
 @functools.lru_cache(maxsize=32)
 def _cached_search(
     mesh: jax.sharding.Mesh, axis: str, store_raw: bool, statics: tuple,
@@ -1153,10 +1203,15 @@ def _cached_search(
     (k, n_probes, qcap, list_block, refine_ratio, exact_selection,
      approx_recall_target, pq_dim, pq_bits, n_pad, nl_pad, max_list,
      use_coarse, overprobe, merge_ways, replication,
-     replica_offset, use_pallas, pallas_interpret) = statics
+     replica_offset, use_pallas, pallas_interpret, wire) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
     n_ranks = comms.size
+    # 2-level (ICI x DCN) mesh: the merge tail goes hierarchical
+    # (docs/multihost.md); everything before it is per-chip and
+    # unchanged. hier is a pure function of (mesh, axis) — the cache
+    # key already distinguishes it.
+    hier = hier_axes(mesh, axis)
 
     def body(*opnds):
         (cents, cbs, owner, local_id, lcents, codes_s, vecs_s, sids,
@@ -1255,14 +1310,15 @@ def _cached_search(
             # a down shard contributes +inf distances to the merge — its
             # candidates can never displace a live shard's
             vals = jnp.where(alive[rank] > 0, vals, jnp.inf)
-        # k-way merge: one small all_gather pair + select_k, executed
-        # IN-PROGRAM (the cross-shard merge is part of the one serving
-        # dispatch, not host composition); merge_ways pads to deployment
-        # width with +inf/-1 absent-peer payloads
-        pd = ax.allgather(vals)                              # (P, nq, k)
-        pi = ax.allgather(gids)
-        md, mi = merge_parts_select_k(pd, pi, k, ways=merge_ways)
-        mi = jnp.where(jnp.isfinite(md), mi, -1)
+        # k-way merge, executed IN-PROGRAM (the cross-shard merge is
+        # part of the one serving dispatch, not host composition):
+        # flat allgather + select_k on a 1-level mesh (merge_ways pads
+        # to deployment width with +inf/-1 absent-peer payloads), the
+        # two-stage ICI x DCN merge with the compressed wire format on
+        # a 2-level mesh (docs/multihost.md)
+        md, mi = _merge_across_shards(
+            ax, hier, vals, gids, k, merge_ways, wire
+        )
         if degraded:
             # coverage counts a probe served iff SOME live rank serves
             # it under the route — a failed-over shard on a live
@@ -1340,8 +1396,13 @@ def _mutation_operands(mutation, index, n_ranks: int):
     return rm, dv, di
 
 
-def _check_probe_args(index, nl_g, overprobe, merge_ways, n_ranks):
-    """Shared validation of the probe/merge knobs (both engines)."""
+def _check_probe_args(index, nl_g, overprobe, merge_ways, merge_floor,
+                      wire="bf16"):
+    """Shared validation of the probe/merge knobs (both engines).
+    ``merge_floor`` is the width the padded flat merge stage actually
+    runs at — the mesh size on a 1-level mesh, the ICI (per-slice)
+    width on a 2-level mesh, where ``merge_ways`` emulates a wider HOST,
+    not a wider fleet (more hosts just ARE more DCN parts)."""
     errors.expects(
         index.coarse is None or index.coarse.n_cents == nl_g,
         "coarse index covers %d centroids but the probe set has %d — "
@@ -1356,10 +1417,14 @@ def _check_probe_args(index, nl_g, overprobe, merge_ways, n_ranks):
     errors.expects(
         merge_ways is None
         or (isinstance(merge_ways, (int, np.integer))
-            and merge_ways >= n_ranks),
-        "merge_ways=%r must be an int >= the mesh size (%d) — it "
-        "emulates a WIDER deployment's merge, never a narrower one",
-        merge_ways, n_ranks,
+            and merge_ways >= merge_floor),
+        "merge_ways=%r must be an int >= the merge stage width (%d) — "
+        "it emulates a WIDER deployment's merge, never a narrower one",
+        merge_ways, merge_floor,
+    )
+    errors.expects(
+        wire in ("bf16", "f32"),
+        "wire=%r not a known cross-host wire format (bf16 | f32)", wire,
     )
 
 
@@ -1454,6 +1519,7 @@ def mnmg_ivf_pq_search(
     merge_ways: typing.Optional[int] = None,
     use_pallas: typing.Optional[bool] = None,
     mutation=None,
+    wire: str = "bf16",
 ):
     """Distributed grouped ADC search over a list-sharded index.
 
@@ -1532,6 +1598,14 @@ def mnmg_ivf_pq_search(
     segments before the cross-shard merge. All mutation inputs are
     RUNTIME values — upserts, tombstone flips, and health/failover flips
     share one compiled program (docs/mutation.md "Sharded mutation").
+
+    ``wire`` (static; 2-level meshes only) selects the cross-host wire
+    format of the hierarchical merge's DCN stage when ``comms`` is a
+    :class:`~raft_tpu.comms.comms.HierarchicalComms` with more than one
+    slice: ``"bf16"`` (default — compressed values + the f32 rerank
+    tail) or ``"f32"`` (uncompressed, bit-identical to the flat merge
+    by construction). Ignored on 1-level meshes; docs/multihost.md
+    states the byte model and the quantization contract.
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -1546,7 +1620,10 @@ def mnmg_ivf_pq_search(
         "approx_recall_target=%s out of range (0, 1]", approx_recall_target,
     )
     nl_g = index.centroids.shape[0]
-    _check_probe_args(index, nl_g, overprobe, merge_ways, comms.size)
+    n_hosts, inner_width = comms_levels(comms)
+    _check_probe_args(
+        index, nl_g, overprobe, merge_ways, inner_width, wire
+    )
     qcap, _ = resolve_qcap_arg(
         qcap, q, index.centroids, nl_g, n_probes,
         max_drop_frac=qcap_max_drop_frac, coarse=index.coarse,
@@ -1568,6 +1645,9 @@ def mnmg_ivf_pq_search(
         None if merge_ways is None else int(merge_ways),
         int(index.replication), int(index.replica_offset),
         use_pallas, jax.default_backend() != "tpu",
+        # wire only shapes 2-level programs; normalized to None on a
+        # 1-level mesh so the flat program's cache key never splits
+        wire if n_hosts > 1 else None,
     )
     degraded = shard_mask is not None
     errors.expects(

@@ -57,12 +57,14 @@ from raft_tpu.comms.mnmg_ivf import (
     _check_probe_args,
     _coarse_probe_operands,
     _exchange_and_assemble,
+    _merge_across_shards,
     _P3,
     _PROBE_BLOCK_Q,
     _train_coarse_distributed,
     place_index,
     shard_rows,
 )
+from raft_tpu.comms.multihost import comms_levels, hier_axes
 from raft_tpu.spatial.ann.common import (
     CoarseIndex,
     ListStorage,
@@ -76,7 +78,6 @@ from raft_tpu.spatial.ann.ivf_flat import (
     IVFFlatParams,
     _grouped_impl,
 )
-from raft_tpu.spatial.selection import merge_parts_select_k
 
 __all__ = [
     "MnmgIVFFlatIndex", "mnmg_ivf_flat_build",
@@ -122,7 +123,7 @@ class MnmgIVFFlatIndex:
                donate_queries: bool = False, shard_mask=None,
                failover=None, overprobe: float = 2.0,
                merge_ways: typing.Optional[int] = None,
-               mutation=None) -> int:
+               mutation=None, wire: str = "bf16") -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through
         :func:`mnmg_ivf_flat_search` — the Flat sibling of
@@ -143,7 +144,7 @@ class MnmgIVFFlatIndex:
             list_block=list_block, donate_queries=donate_queries,
             shard_mask=shard_mask, failover=failover,
             overprobe=overprobe, merge_ways=merge_ways,
-            mutation=mutation,
+            mutation=mutation, wire=wire,
         )
         jax.block_until_ready(out)
         return qc
@@ -295,10 +296,14 @@ def _cached_search(
     deployment-width in-program merge)."""
     (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list,
      use_coarse, overprobe, merge_ways, replication,
-     replica_offset) = statics
+     replica_offset, wire) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
     n_ranks = comms.size
+    # 2-level (ICI x DCN) mesh -> hierarchical merge tail
+    # (docs/multihost.md); a pure function of the cache key's (mesh,
+    # axis)
+    hier = hier_axes(mesh, axis)
 
     def body(*opnds):
         (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
@@ -384,12 +389,14 @@ def _cached_search(
         if degraded:
             # a down shard contributes +inf distances to the merge
             vals = jnp.where(alive[rank] > 0, vals, jnp.inf)
-        # in-program cross-shard merge (merge_ways pads to deployment
-        # width with +inf/-1 absent-peer payloads — identical results)
-        pd = ax.allgather(vals)                              # (P, nq, k)
-        pi = ax.allgather(gids)
-        md, mi = merge_parts_select_k(pd, pi, k, ways=merge_ways)
-        mi = jnp.where(jnp.isfinite(md), mi, -1)
+        # in-program cross-shard merge: flat allgather + select_k on a
+        # 1-level mesh (merge_ways pads to deployment width with
+        # +inf/-1 absent-peer payloads — identical results), the
+        # two-stage ICI x DCN merge on a 2-level mesh
+        # (docs/multihost.md)
+        md, mi = _merge_across_shards(
+            ax, hier, vals, gids, k, merge_ways, wire
+        )
         if degraded:
             # a failed-over shard on a live replica counts covered
             cov = probe_coverage(serving, alive, row_valid)
@@ -431,6 +438,7 @@ def mnmg_ivf_flat_search(
     overprobe: float = 2.0,
     merge_ways: typing.Optional[int] = None,
     mutation=None,
+    wire: str = "bf16",
 ):
     """Distributed grouped EXACT search over a list-sharded IVF-Flat
     index. Returns (distances, GLOBAL row ids), both (nq, k) replicated
@@ -493,7 +501,10 @@ def mnmg_ivf_flat_search(
         k, index.max_list,
     )
     nl_g = index.centroids.shape[0]
-    _check_probe_args(index, nl_g, overprobe, merge_ways, comms.size)
+    n_hosts, inner_width = comms_levels(comms)
+    _check_probe_args(
+        index, nl_g, overprobe, merge_ways, inner_width, wire
+    )
     qcap, _ = resolve_qcap_arg(
         qcap, q, index.centroids, nl_g, n_probes,
         max_drop_frac=qcap_max_drop_frac, coarse=index.coarse,
@@ -506,6 +517,9 @@ def mnmg_ivf_flat_search(
         index.coarse is not None, float(overprobe),
         None if merge_ways is None else int(merge_ways),
         int(index.replication), int(index.replica_offset),
+        # wire only shapes 2-level programs; normalized to None on a
+        # 1-level mesh so the flat program's cache key never splits
+        wire if n_hosts > 1 else None,
     )
     degraded = shard_mask is not None
     errors.expects(

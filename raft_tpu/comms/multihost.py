@@ -1,0 +1,236 @@
+"""Cross-host serving over a 2-level ICI × DCN mesh — the hierarchical
+merge, its compressed wire format, and the host-aware placement helpers
+(docs/multihost.md).
+
+The single-host sharded engines merge per-chip top-k payloads with one
+deployment-width allgather (:func:`raft_tpu.spatial.selection.
+merge_parts_select_k`). Over ICI that allgather is trivial next to the
+shard compute; over DCN it is the whole serving budget — every chip's
+(nq, k) part crossing every host boundary at f32+int32 width would move
+~10–100× slower than the same bytes over ICI and erase the fused
+program's QPS. The cross-host tail therefore restructures the merge
+around the interconnect hierarchy, the same way
+:meth:`~raft_tpu.comms.comms.HierarchicalComms.hierarchical_allreduce`
+restructures an allreduce:
+
+1. **ICI stage (existing, unchanged).** Each slice allgathers its chips'
+   (nq, k) parts over the ICI axis and runs ``merge_parts_select_k`` —
+   the slice's exact f32 top-k. No DCN traffic.
+2. **DCN stage (this module).** Only each slice's top-k crosses hosts,
+   in a compressed wire format: **bf16 distances + int32 global ids**
+   (6 bytes/candidate vs 8 uncompressed; and D slice parts instead of
+   D·I chip parts — the dominant saving). Selection runs on the widened
+   bf16 keys with per-part provenance.
+3. **The f32 rerank tail.** Each slice recovers the EXACT f32 values of
+   the selected entries it contributed (it still holds its slice top-k
+   uncompressed) through one (nq, k) DCN psum, and the k selected are
+   re-sorted by exact value. Within-top-k order inversions introduced by
+   wire rounding are therefore always repaired; the only representable
+   divergence from the flat merge is a candidate pair straddling the
+   k-boundary closer than one bf16 ulp (documented; ``wire="f32"``
+   removes it at +2 bytes/candidate).
+
+:func:`dcn_merge_accounting` states the byte model both for this
+hierarchy and for the flat deployment-width allgather it replaces;
+tests/test_multihost.py pins the ≥4× saving at host geometry.
+
+Host-side helpers map the host axis onto the flat (P,) rank machinery
+the resilience stack already runs on: :func:`host_rank_mask` expands a
+per-host health mask to ranks, and :func:`host_aware_offset` picks the
+replica stripe that lands every copy of a shard on a different host
+(:meth:`raft_tpu.resilience.ReplicaPlacement.striped` with
+``inner_size=``).
+"""
+
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu import errors
+from raft_tpu.comms.comms import AxisComms, Comms
+from raft_tpu.spatial.selection import merge_parts_provenance_select_k
+
+__all__ = [
+    "comms_levels", "dcn_merge_accounting", "hier_axes",
+    "hierarchical_merge_select_k", "host_aware_offset", "host_rank_mask",
+]
+
+# the compressed wire format: value bytes per candidate by wire dtype,
+# plus the int32 global id every candidate carries either way
+_WIRE_VALUE_BYTES = {"bf16": 2, "f32": 4}
+_WIRE_ID_BYTES = 4
+
+
+def hier_axes(mesh, axis) -> typing.Optional[tuple]:
+    """``(outer_axis, inner_axis, n_hosts, inner_size)`` when ``axis``
+    names a 2-level (outer, inner) mesh with a real outer dimension —
+    the trace-time switch between the flat and hierarchical merge tails
+    — else ``None`` (1-level mesh, or a 2-level mesh with one slice,
+    where the flat tail is already DCN-free)."""
+    if isinstance(axis, tuple) and len(axis) == 2:
+        outer = int(mesh.shape[axis[0]])
+        if outer > 1:
+            return axis[0], axis[1], outer, int(mesh.shape[axis[1]])
+    return None
+
+
+def comms_levels(comms: Comms) -> tuple:
+    """``(n_hosts, inner_size)`` of a communicator: the 2-level shape of
+    a :class:`~raft_tpu.comms.comms.HierarchicalComms`, ``(1, size)``
+    for a flat mesh."""
+    h = hier_axes(comms.mesh, comms.axis)
+    if h is None:
+        return 1, int(comms.size)
+    return h[2], h[3]
+
+
+def hierarchical_merge_select_k(outer: AxisComms, slice_vals, slice_ids,
+                                k: int, *, wire: str = "bf16",
+                                select_min: bool = True):
+    """The DCN stage of the two-stage cross-host merge (device-side:
+    call inside ``shard_map`` over the 2-level mesh, after the ICI-width
+    ``merge_parts_select_k`` produced each slice's exact f32 top-k).
+
+    ``slice_vals`` / ``slice_ids``: this slice's (nq, kk) top-k,
+    best-first, f32 values and GLOBAL int32 ids (replicated within the
+    slice — every chip of a slice runs an identical DCN stage).
+
+    ``wire="bf16"`` (the serving default) exchanges bf16 values + int32
+    ids (6 bytes/candidate), selects on the widened keys with per-slice
+    provenance, recovers the selected entries' exact f32 values from
+    their owning slices through one (nq, k) DCN psum, and re-sorts by
+    exact value — the f32 rerank tail. ``wire="f32"`` exchanges
+    uncompressed values (8 bytes/candidate, no tail needed) and is
+    bit-identical to the flat merge by construction.
+
+    Returns ``(vals (nq, k), ids (nq, k))``, best-first, replicated on
+    every chip. Absent/dead-slice conventions match the flat merge: a
+    +inf candidate keeps +inf through the wire (bf16 preserves ±inf)
+    and the caller maps non-finite rows' ids to -1 exactly as before.
+    """
+    errors.expects(
+        wire in _WIRE_VALUE_BYTES,
+        "wire=%r not a known wire format (bf16 | f32)", wire,
+    )
+    if wire == "f32":
+        gv = outer.allgather(slice_vals)             # (D, nq, kk)
+        gi = outer.allgather(slice_ids)
+        mv, mi, _, _ = merge_parts_provenance_select_k(
+            gv, gi, k, select_min=select_min
+        )
+        return mv, mi
+    my_slice = outer.get_rank()
+    gv = outer.allgather(slice_vals.astype(jnp.bfloat16))
+    gi = outer.allgather(slice_ids)
+    # select on the WIDENED wire keys — the bytes are already spent;
+    # widening only restores a sortable f32 carrier for the select
+    mv, mi, part, slot = merge_parts_provenance_select_k(
+        gv.astype(slice_vals.dtype), gi, k, select_min=select_min
+    )
+    # the f32 rerank tail: each slice contributes the exact values of
+    # its own selected entries (0 elsewhere — provenance is unique), one
+    # small DCN psum reassembles them everywhere
+    mine = part == my_slice
+    contrib = jnp.where(
+        mine, jnp.take_along_axis(slice_vals, slot, axis=1), 0.0
+    )
+    exact = outer.allreduce(contrib)
+    ev, p = lax.top_k(-exact if select_min else exact, k)
+    return (
+        (-ev if select_min else ev),
+        jnp.take_along_axis(mi, p, axis=1),
+    )
+
+
+def dcn_merge_accounting(k: int, n_hosts: int, chips_per_host: int, *,
+                         wire: str = "bf16") -> dict:
+    """Cross-host (DCN) bytes per query of the merge tail, flat vs
+    hierarchical, at a deployment geometry of ``n_hosts`` slices of
+    ``chips_per_host`` chips (docs/multihost.md "Byte accounting").
+
+    The model counts bytes a slice RECEIVES over DCN per query — the
+    quantity the slow interconnect meters; ICI-internal traffic is free
+    by convention. With ``W = n_hosts * chips_per_host`` chips and a
+    candidate costing ``wire`` value bytes + 4 id bytes:
+
+    * **flat** (the deployment-width allgather): every off-host chip's
+      (k,) part arrives uncompressed — ``(W - I) * k * 8``;
+    * **hierarchical**: the other slices' slice-top-k arrive on the
+      wire — ``(D - 1) * k * (wire_bytes + 4)`` — plus, for
+      ``wire="bf16"``, the f32 rerank tail's ring-allreduce traffic
+      ``2 * (D - 1) / D * k * 4``.
+
+    Returns ``{"flat_bytes_per_query", "hier_bytes_per_query",
+    "ratio", ...}``; ``ratio`` ≈ ``I * 8 / (6 + 8/D)`` for bf16 — it
+    grows with chips per host (the flat tail pays per CHIP, the
+    hierarchical one per HOST) and is ≥ 4 from one real 8-chip host up
+    (tests/test_multihost.py pins it)."""
+    errors.expects(
+        wire in _WIRE_VALUE_BYTES,
+        "wire=%r not a known wire format (bf16 | f32)", wire,
+    )
+    errors.expects(
+        n_hosts >= 1 and chips_per_host >= 1 and k >= 1,
+        "dcn_merge_accounting: bad geometry (k=%d, hosts=%d, chips=%d)",
+        k, n_hosts, chips_per_host,
+    )
+    W = n_hosts * chips_per_host
+    flat = (W - chips_per_host) * k * (4 + _WIRE_ID_BYTES)
+    hier = (n_hosts - 1) * k * (_WIRE_VALUE_BYTES[wire] + _WIRE_ID_BYTES)
+    if wire == "bf16" and n_hosts > 1:
+        # exact-recovery psum, ring-allreduce accounting
+        hier += 2.0 * (n_hosts - 1) / n_hosts * k * 4
+    return {
+        "k": k,
+        "n_hosts": n_hosts,
+        "chips_per_host": chips_per_host,
+        "wire": wire,
+        "flat_bytes_per_query": float(flat),
+        "hier_bytes_per_query": float(hier),
+        "ratio": float(flat) / hier if hier else float("inf"),
+    }
+
+
+def host_rank_mask(host_alive, inner_size: int) -> np.ndarray:
+    """Expand a per-host health mask to the flat ``(P,)`` rank mask the
+    degraded searches and :meth:`FailoverPlan.from_health` consume —
+    host h covers ranks ``[h * inner_size, (h+1) * inner_size)`` (the
+    row-major rank order of the 2-level mesh). A dead host takes all
+    its chips down at once; everything downstream (shard_mask, route,
+    coverage) is unchanged rank machinery."""
+    host_alive = np.asarray(host_alive)
+    errors.expects(
+        host_alive.ndim == 1 and inner_size >= 1,
+        "host_rank_mask: expected a 1-D host mask and inner_size >= 1, "
+        "got shape %s, inner_size=%d", tuple(host_alive.shape), inner_size,
+    )
+    return np.repeat(
+        (host_alive != 0).astype(np.int32), inner_size
+    )
+
+
+def host_aware_offset(n_ranks: int, inner_size: int,
+                      replication: int) -> int:
+    """The replica stripe offset that lands every copy of a shard on a
+    DIFFERENT host: a multiple of ``inner_size`` (so copies step whole
+    hosts) with the host step ``max(1, n_hosts // R)`` (so R copies
+    spread across the host ring — the host-axis analog of the flat
+    default ``P // R``). Requires R ≤ n_hosts: more copies than hosts
+    cannot be host-disjoint (place with an explicit offset instead)."""
+    errors.expects(
+        n_ranks % max(inner_size, 1) == 0 and inner_size >= 1,
+        "host_aware_offset: n_ranks=%d not a whole number of "
+        "inner_size=%d hosts", n_ranks, inner_size,
+    )
+    n_hosts = n_ranks // inner_size
+    errors.expects(
+        1 <= replication <= n_hosts,
+        "host_aware_offset: R=%d copies cannot land on distinct hosts "
+        "(%d hosts) — pass an explicit replica_offset to accept "
+        "same-host copies", replication, n_hosts,
+    )
+    return inner_size * max(1, n_hosts // replication)

@@ -59,18 +59,53 @@ class ReplicaPlacement:
     n_ranks: int
     replication: int
     offset: int
+    # chips per host: >1 records (and enforces) HOST-AWARE placement —
+    # rank r lives on host r // inner_size, and every shard's R copies
+    # must land on R distinct hosts (docs/multihost.md "Host-aware
+    # placement"). 1 = the single-host/rank-only contract of PR 5.
+    inner_size: int = 1
 
     @classmethod
     def striped(cls, n_ranks: int, replication: int,
-                offset: "int | None" = None) -> "ReplicaPlacement":
+                offset: "int | None" = None, *,
+                inner_size: "int | None" = None) -> "ReplicaPlacement":
         """The standard placement. ``offset`` defaults to
         ``max(1, n_ranks // replication)`` — for R=2 that pairs rank
         ``r`` with ``r + P/2``, so a correlated failure of ADJACENT
         ranks (one host's chips) never takes out both copies of a
         shard. Any offset is accepted as long as every shard's R
-        holders are distinct ranks."""
+        holders are distinct ranks.
+
+        ``inner_size`` (chips per host) engages the HOST axis: the
+        default offset becomes the host-aware stripe
+        ``inner_size * max(1, n_hosts // R)`` — copies step WHOLE
+        hosts, so a whole dead host (all its chips at once, the
+        realistic multi-host failure unit) still leaves every shard a
+        live copy — and ANY offset (default or explicit) is validated
+        to land each shard's R copies on R distinct hosts. Requires
+        R ≤ n_hosts: more copies than hosts cannot be host-disjoint
+        (docs/multihost.md "Host-aware placement";
+        :func:`raft_tpu.comms.multihost.host_aware_offset` is the
+        comms-level sibling of the same stripe)."""
+        inner = 1 if inner_size is None else int(inner_size)
+        errors.expects(
+            inner >= 1 and (inner == 1 or n_ranks % inner == 0),
+            "inner_size=%d: n_ranks=%d is not a whole number of hosts",
+            inner, n_ranks,
+        )
         if offset is None:
-            offset = max(1, n_ranks // max(replication, 1))
+            if inner > 1:
+                n_hosts = n_ranks // inner
+                errors.expects(
+                    replication <= n_hosts,
+                    "replication=%d copies cannot land on distinct "
+                    "hosts (%d hosts of %d chips) — pass an explicit "
+                    "offset to accept same-host copies",
+                    replication, n_hosts, inner,
+                )
+                offset = inner * max(1, n_hosts // max(replication, 1))
+            else:
+                offset = max(1, n_ranks // max(replication, 1))
         errors.expects(
             1 <= replication <= n_ranks,
             "replication=%d out of range [1, n_ranks=%d] — a rank "
@@ -85,7 +120,21 @@ class ReplicaPlacement:
                 "(two copies of one shard would land on the same rank)",
                 offset, delta, n_ranks,
             )
-        return cls(n_ranks=n_ranks, replication=replication, offset=offset)
+        p = cls(n_ranks=n_ranks, replication=replication, offset=offset,
+                inner_size=inner)
+        if inner > 1:
+            # the stripe validation above is necessary but not
+            # sufficient (offsets near a host boundary can wrap two
+            # copies onto one host) — check the actual holder sets
+            for s in range(n_ranks):
+                hosts = [r // inner for r in p.holders(s)]
+                errors.expects(
+                    len(set(hosts)) == replication,
+                    "offset=%d places shard %d's copies on hosts %s — "
+                    "not host-disjoint (inner_size=%d)",
+                    offset, s, hosts, inner,
+                )
+        return p
 
     @classmethod
     def of_index(cls, index) -> "ReplicaPlacement":
@@ -118,6 +167,27 @@ class ReplicaPlacement:
         return tuple(
             (rank - j * self.offset) % self.n_ranks
             for j in range(self.replication)
+        )
+
+    def holder_hosts(self, shard: int) -> Tuple[int, ...]:
+        """The hosts storing ``shard``'s copies, primary first (host =
+        rank // inner_size; all zeros when the placement carries no
+        host axis)."""
+        return tuple(
+            r // max(self.inner_size, 1) for r in self.holders(shard)
+        )
+
+    @property
+    def host_disjoint(self) -> bool:
+        """True iff every shard's R copies land on R distinct hosts —
+        the whole-host-failure survival contract (a host-aware
+        ``striped(..., inner_size=)`` placement guarantees it at
+        construction; docs/multihost.md)."""
+        if self.inner_size <= 1:
+            return self.replication == 1
+        return all(
+            len(set(self.holder_hosts(s))) == self.replication
+            for s in range(self.n_ranks)
         )
 
     @property
@@ -175,6 +245,35 @@ class FailoverPlan:
                     route[s] = j
                     break
         return cls(placement=placement, route=route)
+
+    @classmethod
+    def from_host_health(cls, placement: ReplicaPlacement,
+                         host_alive: Any,
+                         inner_size: "int | None" = None) -> "FailoverPlan":
+        """The HOST-failure form of :meth:`from_health`: ``host_alive``
+        is a per-HOST mask (host h covers ranks
+        ``[h*inner_size, (h+1)*inner_size)`` — the row-major rank order
+        of the 2-level mesh), expanded to the flat rank mask and routed
+        exactly as rank failures are. With a host-aware placement
+        (``striped(..., inner_size=)``) and R=2, any single whole dead
+        host keeps every shard served (``fully_covered``) — the
+        multi-host failure contract (docs/multihost.md "Host failure
+        semantics"). ``inner_size`` defaults to the placement's own."""
+        inner = placement.inner_size if inner_size is None else int(inner_size)
+        errors.expects(
+            inner >= 1 and placement.n_ranks % inner == 0,
+            "from_host_health: inner_size=%d does not tile n_ranks=%d",
+            inner, placement.n_ranks,
+        )
+        host_alive = np.asarray(host_alive)
+        errors.expects(
+            host_alive.shape == (placement.n_ranks // inner,),
+            "from_host_health: expected a (%d,) per-host mask, got "
+            "shape %s", placement.n_ranks // inner,
+            tuple(host_alive.shape),
+        )
+        alive = np.repeat((host_alive != 0).astype(np.int32), inner)
+        return cls.from_health(placement, alive)
 
     @property
     def fully_covered(self) -> bool:

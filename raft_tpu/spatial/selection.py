@@ -24,8 +24,8 @@ from jax import lax
 from raft_tpu import errors
 
 __all__ = [
-    "SelectKAlgo", "merge_parts_select_k", "merge_topk", "select_k",
-    "select_k_blocked",
+    "SelectKAlgo", "merge_parts_provenance_select_k",
+    "merge_parts_select_k", "merge_topk", "select_k", "select_k_blocked",
 ]
 
 
@@ -160,6 +160,33 @@ def merge_parts_select_k(part_vals, part_ids, k: int, *, ways=None,
     flat_v = part_vals.transpose(1, 0, 2).reshape(nq, -1)
     flat_i = part_ids.transpose(1, 0, 2).reshape(nq, -1)
     return select_k(flat_v, k, select_min=select_min, indices=flat_i)
+
+
+def merge_parts_provenance_select_k(part_vals, part_ids, k: int, *,
+                                    select_min: bool = True):
+    """:func:`merge_parts_select_k` that also reports WHICH part each
+    selected entry came from — the DCN-level merge of the hierarchical
+    cross-host tail needs the provenance to recover exact f32 values
+    from the owning slice after selecting on compressed bf16 wire keys
+    (:func:`raft_tpu.comms.multihost.hierarchical_merge_select_k`;
+    docs/multihost.md "The two-stage merge").
+
+    ``part_vals`` / ``part_ids``: (P, nq, kk) stacked per-part top-k
+    payloads, each part best-first. Returns ``(vals (nq, k),
+    ids (nq, k), part (nq, k), slot (nq, k))`` — ``part[i, j]`` is the
+    source part of entry j and ``slot[i, j]`` its row position within
+    that part's payload.
+    """
+    n_parts, nq, kk = part_vals.shape
+    flat_v = part_vals.transpose(1, 0, 2).reshape(nq, -1)
+    flat_i = part_ids.transpose(1, 0, 2).reshape(nq, -1)
+    vals, pos = select_k(flat_v, k, select_min=select_min)
+    ids = jnp.take_along_axis(flat_i, pos, axis=1)
+    return (
+        vals, ids,
+        (pos // kk).astype(jnp.int32),
+        (pos % kk).astype(jnp.int32),
+    )
 
 
 def merge_topk(vals_a, idx_a, vals_b, idx_b, *, select_min: bool = True):

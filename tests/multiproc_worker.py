@@ -119,6 +119,44 @@ def main() -> None:
         and float(np.asarray(df)[:, 0].max()) < 1e-2
     )
 
+    # the cross-host serving tier under REAL process boundaries
+    # (ISSUE 9): the 2-level mesh's outer (dcn) axis IS the process
+    # boundary here — global devices order process-major, so
+    # mesh_shape=(num_procs, 2) puts each process's 2 local devices in
+    # one slice and the hierarchical merge's DCN stage crosses gloo, not
+    # just a virtual in-process mesh. Same index, same queries: the
+    # two-stage merge with the uncompressed wire must return the flat
+    # program's (dists, ids) bit-identically on every rank.
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.comms import build_comms_hierarchical, place_index
+
+    hier = build_comms_hierarchical(mesh_shape=(num_procs, 2))
+    hidx = place_index(hier, fidx)
+    dh, jh = mnmg_ivf_flat_search(
+        hier, hidx, x[:16], 3, n_probes=8, qcap=16, wire="f32",
+    )
+    hier_matches = bool(
+        (np.asarray(jh) == np.asarray(jf)).all()
+        and (np.asarray(dh) == np.asarray(df)).all()
+    )
+
+    # the padded hierarchical_allreduce (ISSUE 9 satellite) across the
+    # same real DCN boundary: odd leading dim, every device agrees on
+    # the plain psum result
+    def _allred(v):
+        return hier.hierarchical_allreduce(v)
+
+    fn = jax.jit(hier.shard_map(
+        _allred, in_specs=P(None, None), out_specs=P(None, None),
+    ))
+    v = np.arange(7 * 3, dtype=np.float32).reshape(7, 3)
+    width = float(len(jax.devices()))
+    hier_allreduce_ok = bool(np.allclose(
+        np.asarray(fn(jnp.asarray(v))), width * v, rtol=1e-5,
+    ))
+
     print(json.dumps({
         "rank": rank,
         "process_count": jax.process_count(),
@@ -131,6 +169,9 @@ def main() -> None:
         "ivf_ids_sum": int(iq_np.sum()),
         "ivf_dist_build_matches": dist_matches_wrapper,
         "ivf_flat_self_exact": flat_self,
+        "hier_merge_matches_flat": hier_matches,
+        "hier_merge_ids_sum": int(np.asarray(jh).sum()),
+        "hier_allreduce_pad_ok": hier_allreduce_ok,
     }), flush=True)
 
 

@@ -758,6 +758,124 @@ def test_sync_in_hot_path_suppression_honored():
     assert out == []
 
 
+# -- dcn-wide-collective -----------------------------------------------------
+
+def dcn_findings(src, rel="raft_tpu/comms/frontend.py"):
+    out = lint_source(textwrap.dedent(src), rel=rel)
+    return [f for f in out if f.rule == "dcn-wide-collective"]
+
+
+def test_dcn_wide_collective_flags_both_level_collectives():
+    # the one-collective-erases-the-win shape: full per-chip payloads
+    # over BOTH mesh levels at once, inside a traced body
+    out = dcn_findings("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def body(vals, gids):
+            pd = lax.all_gather(vals, ("dcn", "ici"))
+            s = lax.psum(gids, ("dcn", "ici"))
+            return pd, s
+    """)
+    assert len(out) == 2
+    msgs = " ".join(f.message for f in out)
+    assert "lax.all_gather" in msgs and "lax.psum" in msgs
+    assert "'dcn'" in msgs and "hierarchical_merge_select_k" in msgs
+
+
+def test_dcn_wide_collective_single_axis_stages_clean():
+    # the hierarchy's own stages — inner-only and dcn-only collectives —
+    # are the FIX, not the hazard
+    out = dcn_findings("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def hier_tail(vals):
+            s = lax.psum_scatter(vals, "ici", tiled=True)
+            s = lax.psum(s, "dcn")
+            return lax.all_gather(s, "ici", tiled=True)
+    """)
+    assert out == []
+
+
+def test_dcn_wide_collective_untraced_body_clean():
+    # host-side composition (no tracer) is not a serving-path dispatch
+    out = dcn_findings("""
+        from jax import lax
+
+        def host_side(vals):
+            return lax.all_gather(vals, ("dcn", "ici"))
+    """)
+    assert out == []
+
+
+def test_dcn_wide_collective_axis_name_kw_and_outer_spelling():
+    out = dcn_findings("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def body(x):
+            return lax.psum(x, axis_name=("outer", "inner"))
+    """)
+    assert len(out) == 1 and "'outer'" in out[0].message
+
+
+def test_dcn_wide_collective_pmean_flagged():
+    # pmean moves the same per-chip payload bytes as psum — a mean over
+    # both levels must not evade the rule
+    out = dcn_findings("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def body(x):
+            return lax.pmean(x, ("dcn", "ici"))
+    """)
+    assert len(out) == 1 and "lax.pmean" in out[0].message
+
+
+def test_dcn_wide_collective_inner_only_tuple_clean():
+    # a tuple of ici-level axes crosses no host boundary
+    out = dcn_findings("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def body(x):
+            return lax.psum(x, ("ici_x", "ici_y"))
+    """)
+    assert out == []
+
+
+def test_dcn_wide_collective_dynamic_axis_unflagged():
+    # variable axes are beyond a lexical linter — no false positive
+    out = dcn_findings("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def body(x, axes):
+            return lax.psum(x, axes)
+    """)
+    assert out == []
+
+
+def test_dcn_wide_collective_suppression_honored():
+    out = dcn_findings("""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def barrier(x):
+            return lax.psum(jnp.zeros(()), ("dcn", "ici"))  # jaxlint: disable=dcn-wide-collective
+    """)
+    assert out == []
+
+
 # -- engine: baseline, CLI, self-gate ---------------------------------------
 
 FIXTURE_BAD = textwrap.dedent("""

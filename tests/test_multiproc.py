@@ -212,3 +212,22 @@ def test_mnmg_ivf_flat_across_processes(worker_reports):
     scoring returns exact self-neighbors on every rank."""
     for r in worker_reports:
         assert r["ivf_flat_self_exact"] is True, r
+
+
+def test_hierarchical_merge_across_processes(worker_reports):
+    """ISSUE 9 satellite: the 2-level HierarchicalComms carries a real
+    workload across the REAL process boundary — the worker builds the
+    (num_procs, 2) mesh whose dcn axis is the process split, runs the
+    two-stage hierarchical merge end-to-end, and its (dists, ids) must
+    be bit-identical to the single-host flat-merge program on the same
+    data, with all ranks agreeing on the merged ids."""
+    for r in worker_reports:
+        assert r["hier_merge_matches_flat"] is True, r
+    assert len({r["hier_merge_ids_sum"] for r in worker_reports}) == 1
+
+
+def test_hierarchical_allreduce_pad_across_processes(worker_reports):
+    """The pad-and-slice hierarchical_allreduce fix holds over real DCN:
+    an odd leading dim reduces to the plain psum result on every rank."""
+    for r in worker_reports:
+        assert r["hier_allreduce_pad_ok"] is True, r

@@ -611,6 +611,111 @@ def test_round8_bench_line_parses_with_open_loop():
         assert "saturation_qps" in orow and "program_qps" in orow
 
 
+def test_round9_bench_line_parses_with_cross_host():
+    """ISSUE 9 satellite (the _fit_line parse/cap test extended,
+    following the r05-r08 pattern): the round-9 artifact shape — every
+    prior row PLUS the cross-host serving row — must print as a line
+    that json.loads-round-trips under the 1800-char driver cap, with
+    the cross-host acceptance keys (e2e QPS, dcn_bytes_ratio, the
+    zero-retrace host-flip audit) surviving every trim stage."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r9", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    serving_rows = [
+        {"engine": e, "nq": nq, "p50_ms": 1.2345, "spread": 0.08,
+         "repeats": 5, "qcap": 24}
+        for e in ("fused_knn", "ivf_flat", "ivf_pq")
+        for nq in (1, 128, 1024)
+    ] + [
+        {"engine": "ivf_flat", "scenario": "hedged_straggler", "nq": 128,
+         "p50_ms": 1.9, "p99_ms": 31.4, "hedged_p99_ms": 6.2,
+         "n_requests": 64},
+        {"engine": "ivf_flat", "scenario": "overload_2x", "nq": 128,
+         "p50_ms": 2.0, "shed_rate": 0.47, "p99_ms": 22.7},
+        {"engine": "ivf_flat", "scenario": "mixed_ingest", "nq": 128,
+         "frozen_qps": 52000.0, "ingest_qps": 310000.0,
+         "mixed_search_qps": 45000.0, "spread": 0.06, "repeats": 5,
+         "qps_ratio_vs_frozen": 0.865, "upsert_visible_ms": 4.2,
+         "delete_masked_ms": 2.9},
+        {"engine": "ivf_flat", "scenario": "open_loop", "nq": 1024,
+         "program_qps": 610000.0, "saturation_qps": 512000.0,
+         "qps_ratio_vs_program": 0.839, "spread": 0.04, "repeats": 5,
+         "p50_ms_50": 2.4, "p99_ms_50": 5.1, "p50_ms_80": 3.0,
+         "p99_ms_80": 7.9, "p50_ms_95": 4.2, "p99_ms_95": 14.6,
+         "shed_rate_95": 0.012},
+    ]
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01,
+         "vs_prev_qcap8_qps": 0.99, "vs_prev_build_warm_s": 1.0}
+        for i in range(8)
+    ] + [
+        # the round-9 cross-host row, every key cross_host_row emits
+        {"metric": "mnmg_cross_host_131072x64_q512_k10_hostsim_2x4",
+         "value": 48123.4, "unit": "QPS", "spread": 0.07, "repeats": 5,
+         "escalations": 1, "flat_e2e_qps": 50620.9,
+         "qps_ratio_vs_flat": 0.951, "wire": "bf16",
+         "dcn_bytes_per_query": 100.0,
+         "flat_dcn_bytes_per_query": 320.0, "dcn_bytes_ratio": 3.2,
+         "merge_ms_hier": 0.42, "merge_ms_flat": 0.31,
+         "health_flip_retraces": 0, "coverage_host_down": 1.0,
+         "host_down_bitident": True, "vs_prev": 1.0,
+         "vs_prev_flat_e2e_qps": 1.0},
+        {"metric": "serving_p50_500000x96_k10_p16", "unit": "ms",
+         "rows": serving_rows},
+        {"metric": "warm_start_build_500000x96", "unit": "s",
+         "value": 3.1, "build_warm_s": 1.9, "within_2x_warm": True},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    xrow = next((e for e in parsed["extras"]
+                 if str(e.get("metric", "")).startswith(
+                     "mnmg_cross_host")), None)
+    assert xrow is not None
+    assert xrow["value"] == 48123.4         # primary survives any trim
+    # the acceptance keys are not in _TRIM_ORDER and print whitelisted,
+    # so they only fall at the last-resort _core_projection
+    if "dcn_bytes_ratio" in xrow:           # not core-projected
+        assert xrow["dcn_bytes_ratio"] == 3.2
+        assert xrow["qps_ratio_vs_flat"] == 0.951
+        assert xrow["health_flip_retraces"] == 0
+        assert xrow["coverage_host_down"] == 1.0
+        assert xrow["host_down_bitident"] is True
+    for key in ("dcn_bytes_ratio", "qps_ratio_vs_flat",
+                "health_flip_retraces", "coverage_host_down",
+                "host_down_bitident"):
+        assert key not in benchtop._TRIM_ORDER
+        assert key in benchtop._PRINT_KEYS
+    # ... and the row's _compact projection always carries them (the
+    # full-row pre-trim shape, the retired-keys test's sibling check)
+    c = benchtop._compact(extras[8])
+    for key in ("value", "dcn_bytes_ratio", "qps_ratio_vs_flat",
+                "health_flip_retraces", "coverage_host_down",
+                "host_down_bitident", "wire"):
+        assert key in c, key
+
+
 def test_mixed_ingest_row_tiny_config():
     """ISSUE 7: the mixed read/write row on a tiny CPU config — frozen
     vs under-ingest search QPS (ratio stamped), sustained ingest QPS,
