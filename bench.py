@@ -380,6 +380,90 @@ def extra_ivf_pq():
     return out
 
 
+def _scan_engine(index, nq, n_probes, *, qcap):
+    """Which flat scan engine the row's grouped/mnmg search resolves to
+    ("pallas" = the sub-chunk-min flat kernel, "xla" = the legacy
+    scan) — the flat sibling of ``_adc_engine``, stamped so the driver
+    can verify the kernel path was actually active. Takes the row's
+    REAL qcap (the kernel's VMEM plan scales with it) so the stamp can
+    never drift from the measured configuration."""
+    from raft_tpu.spatial.ann.common import static_qcap
+    from raft_tpu.spatial.ann.ivf_flat import _resolve_scan_engine
+
+    return "pallas" if _resolve_scan_engine(
+        None, index.centroids.shape[1],
+        static_qcap(qcap, nq, n_probes, index.centroids.shape[0]),
+    ) else "xla"
+
+
+def extra_flat_scan_kernel():
+    """Single-chip grouped IVF-Flat: the XLA scan vs the Pallas
+    sub-chunk-min flat kernel (spatial/ann/flat_kernel) at the shared
+    500k x 96 config — the ISSUE 10 acceptance row (>= 2x at equal
+    recall). ``value`` is the auto-engine QPS (the kernel on TPU),
+    ``xla_qps`` the pinned ``use_pallas=False`` engine on the SAME
+    index and queries, ``speedup`` their ratio; recall@10 is reported
+    for BOTH engines against the exact oracle so "equal recall" is
+    measured, not assumed. On a non-TPU backend auto resolves to the
+    XLA engine and the row degenerates to speedup ~1 (the kernel is
+    TPU-only by auto-select)."""
+    from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+    from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
+    from bench.common import (
+        ann_bench_dataset, chained_dispatch_stats, recall_at_k,
+    )
+
+    n, d, nq, k = 500_000, 96, 4096, 10
+    x, q, true_np = ann_bench_dataset(n, d, nq, k)
+    # same list geometry as the tuned PQ row (docs/ivf_scale.md
+    # "Padded-list tax"): 2048 capped lists keep the padded slab short
+    idx = ivf_flat_build(x, IVFFlatParams(
+        n_lists=2048, kmeans_n_iters=10, kmeans_init="random",
+        max_list_cap=512,
+    ), metric="sqeuclidean")
+    float(jnp.sum(idx.centroids))
+    n_probes = 16
+
+    def make(up):
+        def search(qq):
+            return ivf_flat_search_grouped(
+                idx, qq, k, n_probes=n_probes, qcap="throughput",
+                use_pallas=up,
+            )
+        return search
+
+    stats = {}
+    for label, up in (("auto", None), ("xla", False)):
+        fn = make(up)
+        float(jnp.sum(fn(q)[0]))            # compile + warm
+        st = chained_dispatch_stats(
+            lambda salt: q * (1.0 + 1e-6 * salt), fn, escalate=1,
+        )
+        if st is None:
+            return {"metric": "flat_scan_kernel",
+                    "error": f"{label} timing jitter-dominated"}
+        stats[label] = (st, recall_at_k(fn(q)[1], true_np))
+    st, rec = stats["auto"]
+    st_x, rec_x = stats["xla"]
+    qps = nq / (st["ms"] / 1e3)
+    xla_qps = nq / (st_x["ms"] / 1e3)
+    return {
+        "metric": f"flat_scan_kernel_{n}x{d}_q{nq}_k{k}_p{n_probes}",
+        "value": round(qps, 1),
+        "unit": "QPS",
+        "spread": st["spread"],
+        "repeats": st["repeats"],
+        "escalations": st.get("escalations", 0),
+        "scan_engine": _scan_engine(idx, nq, n_probes,
+                                    qcap="throughput"),
+        "recall_at_10": round(rec, 4),
+        "xla_qps": round(xla_qps, 1),
+        "xla_recall_at_10": round(rec_x, 4),
+        "xla_spread": st_x["spread"],
+        "speedup": round(qps / xla_qps, 2),
+    }
+
+
 def extra_ivf_pq_10m():
     """IVF-PQ at 10M x 96 — the BASELINE DEEP-100M config family scaled
     to one chip (subsample-trained, block-encoded, codes-only index with
@@ -851,6 +935,10 @@ def _mnmg_shard_100m_impl(engine: str):
         # one-dispatch serving rows
         out["adc_engine"] = _adc_engine(idx, nq, 16, qcap="throughput",
                                          refine_ratio=8.0)
+    else:
+        # the flat sibling stamp: which scan engine the shard-local
+        # grouped search inside the fused program resolved to
+        out["scan_engine"] = _scan_engine(idx, nq, 16, qcap="throughput")
     out["n_probe_cents"] = n_gcents
     out["probe_flop_ratio"] = round(flops["ratio"], 2)
     out["probe_recall_vs_flat"] = round(probe_rec, 4)
@@ -975,6 +1063,7 @@ _EXTRAS = {
     "big_knn": extra_big_knn,
     "kmeans": extra_kmeans,
     "ivf_pq": extra_ivf_pq,
+    "flat_scan_kernel": extra_flat_scan_kernel,
     "ivf_pq_10m": extra_ivf_pq_10m,
     "mnmg_ivf_pq": extra_mnmg_ivf_pq,
     "mnmg_shard_100m": extra_mnmg_shard_100m,
@@ -1067,7 +1156,7 @@ def _load_prev_bench():
 _COMPANIONS = ("bf16_iters_per_s", "f32_highest_gflops",
                "brute_force_same_shape_qps", "build_warm_s",
                "qcap8_qps", "measured_chip_qps", "sharded_e2e_qps",
-               "flat_e2e_qps")
+               "flat_e2e_qps", "xla_qps")
 
 
 def _stamp_vs_prev(row, prev):
@@ -1099,6 +1188,9 @@ def _stamp_vs_prev(row, prev):
 _PRINT_KEYS = {
     "metric", "value", "unit", "spread", "repeats", "escalations",
     "error", "adc_engine",
+    # the flat scan-engine stamp + the flat_scan_kernel acceptance row
+    # (ISSUE 10): kernel-vs-XLA QPS on one index, recall both engines
+    "scan_engine", "xla_qps", "xla_recall_at_10", "speedup",
     "recall_at_10", "recall_at_10_vs_shard", "build_s", "build_warm_s",
     "bf16_iters_per_s", "f32_highest_gflops", "vs_baseline",
     "brute_force_same_shape_qps", "measured_chip_qps", "qcap8_qps",
@@ -1152,6 +1244,9 @@ _TRIM_ORDER = (
     "flat_e2e_qps",
     "f32_highest_gflops", "bf16_iters_per_s", "measured_chip_qps",
     "brute_force_same_shape_qps", "qcap8_qps", "build_s",
+    # the flat_scan_kernel row's secondary engine fields fall before
+    # its primary value/speedup/recall do
+    "xla_recall_at_10", "xla_qps",
 )
 
 
@@ -1222,7 +1317,7 @@ def _compact(row):
             continue
         if isinstance(v, str) and key not in (
             "metric", "unit", "error", "engine", "scenario",
-            "adc_engine", "wire"
+            "adc_engine", "scan_engine", "wire"
         ):
             continue
         if isinstance(v, list) and v and isinstance(v[0], dict):

@@ -157,6 +157,71 @@ def main():
         print(json.dumps(rec))
 
     bench_pq_adc_kernel()
+    bench_flat_scan_kernel()
+
+
+def bench_flat_scan_kernel():
+    """The flat scan-block microbench (ISSUE 10): the legacy XLA
+    grouped-flat block — a materialized ``(LB, qcap, L)`` einsum
+    distance tile fed to ``lax.top_k`` — vs the Pallas sub-chunk-min
+    kernel, at FIXED shapes (the per-(list-block) scan work, isolated
+    from probe/regroup/rerank) so the kernel speedup is tracked
+    independently of the end-to-end flat QPS rows in bench.py.
+    Spread-escalated via the shared chained-dispatch harness; on a
+    non-TPU backend the kernel runs in interpret mode and the
+    comparison is semantics-only."""
+    import functools
+
+    from raft_tpu.spatial.ann import flat_kernel
+
+    LB, L, d, Q, kk = 8, 2048, 96, 48, 10
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(11)
+    qv = jax.device_put(rng.standard_normal((LB, Q, d)).astype(np.float32))
+    slabs = jax.device_put(
+        rng.standard_normal((LB, L, d)).astype(np.float32)
+    )
+    slabs_t = jnp.transpose(slabs, (0, 2, 1))
+    bounds = jnp.tile(jnp.asarray([[0, L]], jnp.int32), (LB, 1))
+
+    @jax.jit
+    def xla_block(q_in):
+        # the legacy per-block scan IS the anti-pattern the
+        # wide-distance-materialize lint names: full distance tile
+        # through HBM, selection re-reads it
+        mn = jnp.einsum("bld,bld->bl", slabs, slabs,
+                        preferred_element_type=jnp.float32)
+        qn = jnp.einsum("bqd,bqd->bq", q_in, q_in,
+                        preferred_element_type=jnp.float32)
+        dots = jnp.einsum("bqd,bld->bql", q_in, slabs,
+                          preferred_element_type=jnp.float32)
+        d2 = qn[:, :, None] + mn[:, None, :] - 2.0 * dots
+        vals, _ = jax.lax.top_k(-d2, kk)  # jaxlint: disable=wide-distance-materialize
+        return -vals
+
+    l_tile = flat_kernel.plan_l_tile(d, Q)     # the tile the impl plans
+
+    @functools.partial(jax.jit, static_argnames=("interp",))
+    def kernel_block(q_in, interp=interpret):
+        return flat_kernel.flat_scan_subchunk_min(
+            q_in, slabs_t, bounds, interpret=interp, l_tile=l_tile,
+        )
+
+    rec = {"name": f"ann/flat_scan_kernel/LB{LB}xL{L}xd{d}q{Q}"}
+    for label, fn in (("xla", xla_block), ("pallas", kernel_block)):
+        jax.block_until_ready(fn(qv))
+        st = chained_dispatch_stats(
+            lambda salt: qv * (1.0 + 1e-6 * salt), fn, escalate=1,
+        )
+        if st is None:
+            rec[f"{label}_note"] = "jitter-dominated"
+            continue
+        rec[f"{label}_ms"] = round(st["ms"], 3)
+        rec[f"{label}_spread"] = st["spread"]
+        rec[f"{label}_escalations"] = st.get("escalations", 0)
+    if "xla_ms" in rec and "pallas_ms" in rec:
+        rec["speedup"] = round(rec["xla_ms"] / rec["pallas_ms"], 2)
+    print(json.dumps(rec))
 
 
 def bench_pq_adc_kernel():

@@ -1,4 +1,7 @@
-"""Rule ``adc-gather``: per-candidate LUT gathers on the hot scan path.
+"""Rules ``adc-gather`` and ``wide-distance-materialize``: the hot-scan
+HBM-materialization hazard family.
+
+``adc-gather``: per-candidate LUT gathers on the hot scan path.
 
 A 2^bits-entry lookup table gathered per candidate inside a jitted scan
 body is the ADC anti-pattern this codebase measured twice (docs/
@@ -29,6 +32,22 @@ or the table is small in practice; the remaining hot-path callers (the
 per-query ADC path kept for small-batch latency, and the grouped one-hot
 engine kept as the CPU/interpret fallback) are grandfathered in the
 baseline and burn down with the kernel rollout.
+
+``wide-distance-materialize`` (the family's second member, ISSUE 10):
+a >= 3-subscript-output ``einsum`` — the ``(LB, qcap, L)`` batched
+distance tile of a grouped scan — whose result feeds ``lax.top_k`` /
+``approx_min_k`` inside the same traced body. XLA materializes the full
+tile through HBM just so the selection can read it back and keep k of
+every L values; both flat-scan engines (``fused_knn`` and the
+``flat_kernel`` sub-chunk-min kernel) exist precisely to fuse that
+distance+select so only minima reach HBM (docs/ivf_scale.md "Flat scan
+in VMEM"). Taint flows from the einsum through arithmetic /
+``where`` / method chains (``.reshape``/``.astype``/``.transpose``) and
+stops at any other call boundary, so a 2-d scoring einsum
+(``score_l2_candidates``) or a tile consumed by a reduction never
+flags. The one intentional legacy caller — the XLA grouped flat scan
+kept as the ``use_pallas=False`` bit-stable engine — is grandfathered
+in the baseline.
 """
 
 from __future__ import annotations
@@ -207,4 +226,143 @@ class AdcGatherRule(Rule):
                 yield from self._check_contraction(ctx, node, onehot)
 
 
-RULES = [AdcGatherRule()]
+# selection consumers of a materialized distance tile
+_SELECT_TAILS = {"top_k", "approx_min_k", "approx_max_k"}
+# calls taint flows THROUGH (element-wise selection keeps the tile a
+# tile); every other call boundary stops it
+_TAINT_THROUGH = {"where"}
+# shape-preserving METHODS taint flows through; any other method —
+# notably the reduction spellings .min()/.sum()/.max() — launders it,
+# exactly as the function spellings (jnp.min(d2, ...)) do
+_METHOD_THROUGH = {"reshape", "astype", "transpose", "swapaxes",
+                   "copy", "clip", "view"}
+# a distance tile has at least (batch, query, row) axes
+_WIDE_OUT = 3
+
+
+class WideDistanceMaterializeRule(Rule):
+    name = "wide-distance-materialize"
+    description = (
+        "batched >=3-d einsum distance tile consumed by top_k in a "
+        "traced body — fuse distance+select (flat_kernel/pq_kernel)"
+    )
+
+    def _einsum_out_width(self, ctx, call: ast.Call) -> Optional[int]:
+        """Output-subscript count of an ``einsum`` call with a literal
+        ``"...->..."`` equation; None for anything else (shape-general
+        einsum spellings are rare here and stay unflagged — linter, not
+        shape inference)."""
+        d = ctx.facts.dotted(call.func)
+        if d is None or d.split(".")[-1] != "einsum":
+            return None
+        if not call.args or not isinstance(call.args[0], ast.Constant) \
+                or not isinstance(call.args[0].value, str):
+            return None
+        eq = call.args[0].value
+        if "->" not in eq:
+            return None
+        out = eq.split("->")[-1].strip()
+        return None if "." in out else len(out)
+
+    def _wide_einsum(self, ctx, node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and (self._einsum_out_width(ctx, node) or 0) >= _WIDE_OUT
+        )
+
+    def _tainted(self, ctx, node: ast.AST, names: Set[str]) -> bool:
+        """Does this expression carry a wide-einsum tile — directly, via
+        a tainted name, or through arithmetic / ``where`` / method
+        chains? Any other call boundary launders the taint (a reduction
+        or selection call returns something narrower)."""
+        if isinstance(node, ast.Name):
+            return node.id in names
+        if isinstance(node, ast.BinOp):
+            return self._tainted(ctx, node.left, names) or \
+                self._tainted(ctx, node.right, names)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(ctx, node.operand, names)
+        if isinstance(node, (ast.Subscript, ast.Attribute)):
+            return self._tainted(ctx, node.value, names)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._tainted(ctx, e, names) for e in node.elts)
+        if isinstance(node, ast.Call):
+            if self._wide_einsum(ctx, node):
+                return True
+            d = ctx.facts.dotted(node.func)
+            tail = d.split(".")[-1] if d else None
+            if tail in _TAINT_THROUGH:
+                return any(self._tainted(ctx, a, names)
+                           for a in node.args)
+            if isinstance(node.func, ast.Attribute):
+                # only shape-preserving methods carry the tile through:
+                # a method-spelled reduction (d2.min(axis=2)) launders
+                # exactly like its function spelling
+                if node.func.attr not in _METHOD_THROUGH:
+                    return False
+                base = node.func.value
+                if isinstance(base, ast.Call):
+                    # method chained onto a call's RESULT —
+                    # einsum(...).astype(...), where(...).reshape(...):
+                    # taint is a property of that inner call, so
+                    # re-evaluate it (a laundering call like
+                    # jnp.sum(d2).reshape(...) still returns False
+                    # through this same recursion)
+                    return self._tainted(ctx, base, names)
+                # method chain on a value: d2.reshape(...).astype(...) —
+                # but a MODULE function named like one (jnp.reshape(d2))
+                # must not taint through its module name; an
+                # imported-alias root is a module, a plain value root
+                # is not
+                root = base
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if isinstance(root, ast.Name) and \
+                        root.id not in ctx.facts.aliases:
+                    return self._tainted(ctx, node.func.value, names)
+            return False
+        return False
+
+    def check(self, ctx) -> Iterator:
+        seen: Set[int] = set()  # nested traced fns share body nodes
+        for fn in ctx.facts.traced:
+            # taint fixpoint over single-Name assignments: order-free,
+            # so `d2 = qn + mn - 2*dots; d2 = where(m, inf, d2)` chains
+            # resolve without relying on statement order
+            assigns = [
+                (n.targets[0].id, n.value)
+                for n in ctx.facts.traced_body_nodes(fn)
+                if isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+            ]
+            names: Set[str] = set()
+            while True:
+                grew = False
+                for tgt, val in assigns:
+                    if tgt not in names and self._tainted(ctx, val, names):
+                        names.add(tgt)
+                        grew = True
+                if not grew:
+                    break
+            for node in ctx.facts.traced_body_nodes(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                d = ctx.facts.dotted(node.func)
+                if d is None or d.split(".")[-1] not in _SELECT_TAILS:
+                    continue
+                if not node.args or not self._tainted(
+                    ctx, node.args[0], names
+                ):
+                    continue
+                seen.add(id(node))
+                yield ctx.finding(
+                    self.name, node,
+                    "a wide (>=3-d output) einsum distance tile feeds "
+                    "top_k in a traced body — XLA materializes the "
+                    "(·, qcap, L) tile through HBM for the selection to "
+                    "re-read; fuse distance+select in the Pallas scan "
+                    "engine (spatial/ann/flat_kernel) or suppress",
+                )
+
+
+RULES = [AdcGatherRule(), WideDistanceMaterializeRule()]

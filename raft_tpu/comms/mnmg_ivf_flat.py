@@ -123,7 +123,9 @@ class MnmgIVFFlatIndex:
                donate_queries: bool = False, shard_mask=None,
                failover=None, overprobe: float = 2.0,
                merge_ways: typing.Optional[int] = None,
-               mutation=None, wire: str = "bf16") -> int:
+               mutation=None, wire: str = "bf16",
+               use_pallas: typing.Optional[bool] = None,
+               rerank_ratio: float = 4.0) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through
         :func:`mnmg_ivf_flat_search` — the Flat sibling of
@@ -144,7 +146,8 @@ class MnmgIVFFlatIndex:
             list_block=list_block, donate_queries=donate_queries,
             shard_mask=shard_mask, failover=failover,
             overprobe=overprobe, merge_ways=merge_ways,
-            mutation=mutation, wire=wire,
+            mutation=mutation, wire=wire, use_pallas=use_pallas,
+            rerank_ratio=rerank_ratio,
         )
         jax.block_until_ready(out)
         return qc
@@ -296,7 +299,8 @@ def _cached_search(
     deployment-width in-program merge)."""
     (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list,
      use_coarse, overprobe, merge_ways, replication,
-     replica_offset, wire) = statics
+     replica_offset, use_pallas, pallas_interpret, rerank_ratio,
+     wire) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
     n_ranks = comms.size
@@ -375,9 +379,14 @@ def _cached_search(
         )
         # the UNCHANGED single-chip grouped exact kernel, probes
         # pre-mapped to shard-local list ids; sorted_ids are global
+        # (use_pallas routes the shard-local scan through the Pallas
+        # sub-chunk-min engine INSIDE the fused one-dispatch program —
+        # docs/ivf_scale.md "Flat scan in VMEM")
         vals, gids = _grouped_impl(
             shard, qf, k, n_probes, qcap, list_block, probes=lp,
             row_mask=rm_s[0] if mutation else None,
+            use_pallas=use_pallas, pallas_interpret=pallas_interpret,
+            rerank_ratio=rerank_ratio,
         )
         if mutation:
             from raft_tpu.comms.mnmg_ivf import _merge_local_delta
@@ -439,6 +448,8 @@ def mnmg_ivf_flat_search(
     merge_ways: typing.Optional[int] = None,
     mutation=None,
     wire: str = "bf16",
+    use_pallas: typing.Optional[bool] = None,
+    rerank_ratio: float = 4.0,
 ):
     """Distributed grouped EXACT search over a list-sharded IVF-Flat
     index. Returns (distances, GLOBAL row ids), both (nq, k) replicated
@@ -485,6 +496,16 @@ def mnmg_ivf_flat_search(
     an :class:`~raft_tpu.comms.mnmg_mutation.MnmgMutationState` (or its
     wrapper) and tombstones + delta segments fold into the fused
     program as runtime inputs (docs/mutation.md "Sharded mutation").
+
+    ``use_pallas``/``rerank_ratio`` (both static) select the shard-local
+    scan engine inside the fused program — auto (``None``) engages the
+    Pallas sub-chunk-min flat kernel on TPU exactly as
+    :func:`~raft_tpu.spatial.ann.ivf_flat.ivf_flat_search_grouped`
+    documents (docs/ivf_scale.md "Flat scan in VMEM"); the knob is a
+    trace-time static, so like every other static it never varies with
+    health/failover/mutation state (zero retraces on flips,
+    trace-audited with the kernel engaged). The mutation tier's
+    ``row_mask`` folds in at the kernel path's exact rerank tail.
     """
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
@@ -511,12 +532,18 @@ def mnmg_ivf_flat_search(
         overprobe=overprobe,
     )
     list_block = max(1, min(list_block, index.nl_pad))
+    from raft_tpu.spatial.ann.ivf_flat import _resolve_scan_engine
+
+    use_pallas = _resolve_scan_engine(
+        use_pallas, index.centroids.shape[1], qcap
+    )
     statics = (
         k, n_probes, qcap, list_block, index.n_pad, index.nl_pad,
         index.max_list,
         index.coarse is not None, float(overprobe),
         None if merge_ways is None else int(merge_ways),
         int(index.replication), int(index.replica_offset),
+        use_pallas, jax.default_backend() != "tpu", float(rerank_ratio),
         # wire only shapes 2-level programs; normalized to None on a
         # 1-level mesh so the flat program's cache key never splits
         wire if n_hosts > 1 else None,

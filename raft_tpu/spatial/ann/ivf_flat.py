@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 import typing
 from typing import Tuple
 
@@ -64,7 +65,9 @@ class IVFFlatIndex:
 
     def warmup(self, nq: int, *, k: int = 10, n_probes: int = 8,
                qcap=None, list_block: int = 32,
-               stream_partials=None) -> int:
+               stream_partials=None,
+               use_pallas: typing.Optional[bool] = None,
+               rerank_ratio: float = 4.0) -> int:
         """Pre-compile the grouped serving program for (nq, d) float32
         batches: one all-zeros batch is dispatched through the exact
         serving entry and blocked on, populating the in-process jit cache
@@ -86,6 +89,7 @@ class IVFFlatIndex:
         out = ivf_flat_search_grouped(
             self, q0, k, n_probes=n_probes, qcap=qc,
             list_block=list_block, stream_partials=stream_partials,
+            use_pallas=use_pallas, rerank_ratio=rerank_ratio,
         )
         jax.block_until_ready(out)
         return qc
@@ -155,18 +159,60 @@ def ivf_flat_search(
     return vals, ids
 
 
+def _resolve_scan_engine(use_pallas, d: int, qcap: int) -> bool:
+    """Resolve the ``use_pallas`` knob of the grouped flat searches to a
+    concrete engine choice (a trace-time static) — the flat sibling of
+    :func:`raft_tpu.spatial.ann.ivf_pq._resolve_adc_engine`.
+
+    ``None`` (auto): the Pallas flat-scan engine (spatial/ann/
+    flat_kernel) on a TPU backend whenever the config fits the kernel's
+    VMEM plan; the XLA scan otherwise — so ``JAX_PLATFORMS=cpu`` never
+    imports, let alone compiles, the kernel unless a caller opts in
+    explicitly. ``True`` validates the requirements and raises with the
+    reason when they do not hold (explicit opt-in must not silently fall
+    back). Unlike the PQ resolver there is no refine precondition: the
+    flat index always stores its raw rows, so the kernel path's exact
+    f32 rerank tail is always available."""
+    if use_pallas is None:
+        if jax.default_backend() != "tpu":
+            return False
+        from raft_tpu.spatial.ann.flat_kernel import flat_scan_supported
+
+        return flat_scan_supported(d, qcap)
+    if use_pallas:
+        from raft_tpu.spatial.ann.flat_kernel import flat_scan_supported
+
+        errors.expects(
+            flat_scan_supported(d, qcap),
+            "use_pallas=True unsupported at d=%d qcap=%d (one query "
+            "block + slab tile exceeds the kernel's VMEM plan); use the "
+            "XLA scan (use_pallas=False)", d, qcap,
+        )
+    return bool(use_pallas)
+
+
+# rerank-pool gather budget per lax.map block on the Pallas path: the
+# (blk_q, c*8, d) raw-row gather stays under this regardless of nq
+_RERANK_BLOCK_BYTES = 256 << 20
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "n_probes", "qcap", "list_block",
-                     "stream_partials"),
+                     "stream_partials", "use_pallas", "pallas_interpret",
+                     "rerank_ratio"),
 )
 def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
-                  stream_partials=None, row_mask=None):
+                  stream_partials=None, row_mask=None, use_pallas=False,
+                  pallas_interpret=False, rerank_ratio=4.0):
     # ``row_mask``: optional (n + 1,) RUNTIME live mask over slab
     # positions (the tombstone-deletion input of the mutation tier,
     # raft_tpu/spatial/ann/mutation.py — the shard_mask trick applied to
     # rows). 0 = tombstoned: the row scores +inf and can never surface.
-    # A runtime input, so tombstone flips never recompile.
+    # A runtime input, so tombstone flips never recompile. On the Pallas
+    # path it is applied per ROW at the exact rerank tail (the in-kernel
+    # sub-chunk minima are unmasked — a dead row can crowd a pool slot,
+    # never surface; the PQ precedent, docs/mutation.md).
     storage = index.storage
     n_lists = storage.list_index.shape[0]
     L = storage.max_list
@@ -226,6 +272,55 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
         )
         return -vals, memp
 
+    use_kernel = bool(use_pallas)
+    if use_kernel:
+        from raft_tpu.spatial.ann import flat_kernel
+
+        sub = flat_kernel.SUBCHUNK
+        # the SAME rounding flat_scan_supported validated the VMEM plan
+        # with, so the resolver's approval and this plan cannot drift
+        q_kpad = flat_kernel.pad_queries(qcap)
+        l_tile = flat_kernel.plan_l_tile(d, q_kpad)
+        l_pad = -(-L // l_tile) * l_tile
+        nsc = l_pad // sub
+        rows = index.data_sorted.shape[0]     # n + 1 (sentinel row)
+        rows_pad = max(rows, l_pad)
+        # tiny indexes whose whole slab is shorter than one padded list
+        # window: extend the slab so the clamped dynamic_slice stays in
+        # range (static condition — big indexes never pay the copy)
+        data_src = (
+            index.data_sorted if rows_pad == rows
+            else jnp.pad(index.data_sorted,
+                         ((0, rows_pad - rows), (0, 0)))
+        )
+
+        def block_fn_pallas(lblk):            # (LB,) list ids
+            qids = qmat[lblk]                                # (LB, qcap)
+            qv = q_pad[qids]                                 # (LB, qcap, d)
+            if q_kpad > qcap:
+                qv = jnp.pad(qv, ((0, 0), (0, q_kpad - qcap), (0, 0)))
+            offs = storage.list_offsets[lblk]                # (LB,)
+            szs = storage.list_sizes[lblk]
+            o_c = jnp.minimum(offs, rows_pad - l_pad)        # slice clamp
+            slabs_t = jax.vmap(
+                lambda s: lax.dynamic_slice(data_src, (s, 0), (l_pad, d))
+            )(o_c).transpose(0, 2, 1)                        # (LB, d, l_pad)
+            lo = offs - o_c
+            bounds = jnp.stack([lo, lo + szs], axis=1)       # (LB, 2)
+            mins = flat_kernel.flat_scan_subchunk_min(
+                qv, slabs_t, bounds,
+                interpret=pallas_interpret, l_tile=l_tile,
+            )[:, :qcap]                                      # (LB, qcap, nsc)
+            # positions are NOT returned: a sub-chunk's slab base is
+            # fully derivable from (probe slot, chunk index) after
+            # selection, so the kernel path pools VALUES ONLY — half
+            # the pool memory and scatter traffic of the legacy path
+            return mins
+
+        width, scan_fn = nsc, block_fn_pallas
+    else:
+        width, scan_fn = k, block_fn
+
     # pad the list axis up to a multiple of list_block (clamped ids — the
     # padded slots recompute the last list; regroup never references
     # them, and the streamed scatter re-writes identical values) instead
@@ -237,27 +332,52 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
     ).reshape(-1, list_block)
 
     if stream_partials is None:
-        # auto: stream once materialized (n_lists, qcap, k) partials pass
-        # ~2 GB (same skewed-qcap blow-up bound as the PQ grouped search)
-        stream_partials = n_lists * qcap * k * 8 > (1 << 31)
+        # auto: stream once materialized (n_lists, qcap, width) partials
+        # pass ~2 GB (same skewed-qcap blow-up bound as the PQ grouped
+        # search); the kernel path pools values only (no int32
+        # positions), hence the smaller footprint
+        per_entry = 4 if use_kernel else 8
+        stream_partials = n_lists * qcap * width * per_entry > (1 << 31)
     if stream_partials:
-        def scan_body(carry, lblk):
-            pvc, pmc = carry
-            v, mp = block_fn(lblk)
-            qi, ri = qmat[lblk], rmat[lblk]          # sentinels drop
-            pvc = pvc.at[qi, ri].set(v, mode="drop")
-            pmc = pmc.at[qi, ri].set(mp, mode="drop")
-            return (pvc, pmc), None
+        if use_kernel:
+            def scan_body_v(pvc, lblk):
+                v = scan_fn(lblk)
+                qi, ri = qmat[lblk], rmat[lblk]      # sentinels drop
+                return pvc.at[qi, ri].set(v, mode="drop"), None
 
-        init = (
-            jnp.full((nq, p, k), jnp.inf, jnp.float32),
-            jnp.full((nq, p, k), storage.n, jnp.int32),
-        )
-        (pv, pm), _ = lax.scan(scan_body, init, lids)
-        pv = pv.reshape(nq, p * k)
-        pm = pm.reshape(nq, p * k)
+            pv, _ = lax.scan(
+                scan_body_v,
+                jnp.full((nq, p, width), jnp.inf, jnp.float32), lids,
+            )
+            pv, pm = pv.reshape(nq, p * width), None
+        else:
+            def scan_body(carry, lblk):
+                pvc, pmc = carry
+                v, mp = scan_fn(lblk)
+                qi, ri = qmat[lblk], rmat[lblk]      # sentinels drop
+                pvc = pvc.at[qi, ri].set(v, mode="drop")
+                pmc = pmc.at[qi, ri].set(mp, mode="drop")
+                return (pvc, pmc), None
+
+            init = (
+                jnp.full((nq, p, k), jnp.inf, jnp.float32),
+                jnp.full((nq, p, k), storage.n, jnp.int32),
+            )
+            (pv, pm), _ = lax.scan(scan_body, init, lids)
+            pv = pv.reshape(nq, p * k)
+            pm = pm.reshape(nq, p * k)
+    elif use_kernel:
+        vals = lax.map(scan_fn, lids)
+        vals = vals.reshape(nl_pad, qcap, width)[:n_lists]
+        # values-only regroup (the slot inverse of regroup_pairs)
+        ok = slot < qcap
+        safe_slot = jnp.minimum(slot, qcap - 1)
+        pv = jnp.where(
+            ok[:, None], vals[l_flat, safe_slot], jnp.inf
+        ).reshape(nq, p * width)
+        pm = None
     else:
-        vals, mem = lax.map(block_fn, lids)
+        vals, mem = lax.map(scan_fn, lids)
         vals = vals.reshape(nl_pad, qcap, k)[:n_lists]
         mem = mem.reshape(nl_pad, qcap, k)[:n_lists]
 
@@ -265,6 +385,71 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
         from raft_tpu.spatial.ann.common import regroup_pairs
 
         pv, pm = regroup_pairs(vals, mem, l_flat, slot, nq, p, qcap)
+
+    if use_kernel:
+        # kernel path: pool entries are SUB-CHUNK minima. Select the
+        # top-c sub-chunks — the fused_knn/PR 6 cover argument at 8-row
+        # granularity: every rank-c row lives in a sub-chunk whose
+        # minimum is <= the c-th best scanned value, so the selected
+        # sub-chunks' rows cover the top-c rows — then rescore their
+        # rows with EXACT f32 at HIGHEST precision (the distance tile
+        # never round-trips HBM; returned distances are exact). Clamp
+        # to the pool width LAST: a large k (> p*width) must not ask
+        # top_k for more sub-chunks than exist — the clamped pool still
+        # covers k rows (c*8 = p*l_pad >= p*max_list >= k, the
+        # check_candidate_pool precondition).
+        from raft_tpu.spatial.ann.common import (
+            map_query_blocks, score_l2_candidates, select_candidates,
+        )
+
+        c = min(p * width, max(k, int(math.ceil(rerank_ratio * k))))
+        nv, cpos = lax.top_k(-pv, c)
+        nadc = -nv                                           # (nq, c)
+        cpos = cpos.astype(jnp.int32)
+        # slab positions are DERIVED, not pooled: pool index -> (probe
+        # slot, chunk), and the sub-chunk's base replays the block's
+        # clamped dynamic-slice origin o_c = min(offset, rows_pad-l_pad)
+        offs_q = storage.list_offsets[probes]                # (nq, p)
+        szs_q = storage.list_sizes[probes]
+        slot_sel = cpos // width
+        off_sel = jnp.take_along_axis(offs_q, slot_sel, axis=1)
+        end_sel = off_sel + jnp.take_along_axis(szs_q, slot_sel, axis=1)
+        base_sel = (
+            jnp.minimum(off_sel, rows_pad - l_pad)
+            + sub * (cpos % width)
+        )                                                    # (nq, c)
+        # per-row validity: a sub-chunk window can overhang its list's
+        # tail into the NEXT list's slab rows — mask against the exact
+        # [offset, offset+size) range of the probe slot it came from
+        rows_sel = base_sel[:, :, None] + jnp.arange(sub, dtype=jnp.int32)
+        validf = (
+            (rows_sel >= off_sel[:, :, None])
+            & (rows_sel < end_sel[:, :, None])
+            & (jnp.isfinite(nadc)
+               & (nadc < flat_kernel.BIG))[:, :, None]
+        )
+        if row_mask is not None:
+            # tombstones are applied per ROW at the rerank tail on the
+            # kernel path (the in-kernel sub-chunk minima are unmasked)
+            validf = validf & (
+                row_mask[jnp.clip(rows_sel, 0, storage.n)] > 0
+            )
+        validf = validf.reshape(nq, c * sub)
+        rpos = rows_sel.reshape(nq, c * sub)
+
+        def rerank_blk(args):
+            qb, rp, vl = args
+            raw = data_src[jnp.clip(rp, 0, storage.n)].astype(f32)
+            exact = score_l2_candidates(qb, raw, vl & (rp < storage.n))
+            return select_candidates(storage, rp, exact, k)
+
+        # block the (blk_q, c*8, d) raw-row gather over queries so the
+        # 8x-wider kernel-path pool never materializes a multi-GB
+        # transient at serving batch sizes (zero-padded rows compute on
+        # all-invalid candidates and are sliced away)
+        blk_q = max(8, min(nq, _RERANK_BLOCK_BYTES // (c * sub * d * 4)))
+        return map_query_blocks(rerank_blk, (qf, rpos, validf), blk_q)
+
     fvals, fpos = lax.top_k(-pv, k)
     fmem = jnp.take_along_axis(pm, fpos, axis=1)
     ids = storage.sorted_ids[jnp.clip(fmem, 0, storage.n - 1)]
@@ -277,6 +462,8 @@ def ivf_flat_search_grouped(
     qcap: typing.Union[int, str, None] = None, list_block: int = 32,
     stream_partials: typing.Optional[bool] = None,
     qcap_max_drop_frac: typing.Optional[float] = None,
+    use_pallas: typing.Optional[bool] = None,
+    rerank_ratio: float = 4.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Throughput-mode IVF search, grouped by LIST instead of by query —
     the query-side "sorted-by-list batching" (SURVEY.md §7 hard part №3).
@@ -304,6 +491,23 @@ def ivf_flat_search_grouped(
     :func:`raft_tpu.spatial.ann.common.throughput_qcap` for when that
     trade is and is not safe.
 
+    ``use_pallas`` selects the scan engine (docs/ivf_scale.md "Flat scan
+    in VMEM"): ``None`` (auto) runs the Pallas sub-chunk-min kernel
+    (spatial/ann/flat_kernel) on a TPU backend whenever the config fits
+    its VMEM plan — the bf16 slab tiles then live only in VMEM, only
+    (qcap, max_list/8) sub-chunk minima reach HBM, and the top-``c``
+    sub-chunks' rows are rescored in exact f32 (HIGHEST) before the
+    final selection, so returned distances stay exact. ``False`` pins
+    the XLA scan (the CPU fallback — bit-stable with previous
+    releases); ``True`` opts in explicitly (interpret mode off-TPU) and
+    raises when the requirements do not hold. Returned candidates are
+    value-exact between engines (the kernel's rerank pool covers the
+    top-k by the sub-chunk cover argument at ``rerank_ratio`` margin);
+    tied candidates may order differently, and distances agree to the
+    last ulp (bitwise on integer-exact data — the tier-1 pin).
+    ``rerank_ratio`` sizes the rerank pool (top ``ceil(rerank_ratio*k)``
+    sub-chunks, clamped to the pool width); kernel path only.
+
     Exactness: with ``qcap`` large enough this returns exactly what
     ``ivf_flat_search`` returns for the same ``n_probes`` (tested).
     """
@@ -312,6 +516,12 @@ def ivf_flat_search_grouped(
     storage = index.storage
     if k > storage.max_list:
         # a single list cannot fill a per-list top-k row
+        errors.expects(
+            not use_pallas,
+            "use_pallas=True: k=%d > max_list=%d routes to the per-query "
+            "search, which has no kernel path; lower k or rebuild with "
+            "fewer lists", k, storage.max_list,
+        )
         return ivf_flat_search(index, q, k, n_probes=n_probes)
     check = k <= n_probes * storage.max_list
     if not check:
@@ -324,9 +534,15 @@ def ivf_flat_search_grouped(
         max_drop_frac=qcap_max_drop_frac,
     )
     list_block = max(1, min(list_block, n_lists))
+    use_pallas = _resolve_scan_engine(
+        use_pallas, index.centroids.shape[1], qcap
+    )
     vals, ids = _grouped_impl(
         index, q, k, n_probes, qcap, list_block, probes=probes,
         stream_partials=stream_partials,
+        use_pallas=use_pallas,
+        pallas_interpret=jax.default_backend() != "tpu",
+        rerank_ratio=float(rerank_ratio),
     )
     if index.metric == "l2":
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu import compat
+from raft_tpu import compat, errors
 
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
 from raft_tpu.spatial.ann.common import (
@@ -79,8 +79,33 @@ def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
     return IVFSQIndex(cents, codes_sorted, vmin, vscale, storage)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))
 def ivf_sq_search(
+    index: IVFSQIndex, queries, k: int, *, n_probes: int = 8,
+    block_q: int = 512, use_pallas: typing.Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-query IVF-SQ search (dequantization fused into candidate
+    scoring). ``use_pallas`` exists only to fail LOUDLY: the SQ engine
+    stores int8 codes, and the Pallas flat-scan kernel's shared block_fn
+    (spatial/ann/flat_kernel) contracts raw bf16 slab rows — routing SQ
+    codes through it would dequantize per list block and forfeit the
+    int8 memory win, so the engine has no kernel path and the rollout
+    must not silently skip it. ``None``/``False`` run the XLA path
+    (identical results); ``True`` raises naming the unmet requirement
+    (tested in tests/test_flat_kernel.py so the gap stays visible)."""
+    errors.expects(
+        not use_pallas,
+        "use_pallas=True: the int8 IVF-SQ engine has no Pallas scan "
+        "path — the flat kernel's block_fn scans raw bf16 slabs, not "
+        "SQ codes (dequantizing per block would forfeit the int8 "
+        "memory win); use IVF-Flat for the kernel engine, or "
+        "use_pallas=False here",
+    )
+    return _sq_search_impl(index, queries, k, n_probes=n_probes,
+                           block_q=block_q)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "block_q"))
+def _sq_search_impl(
     index: IVFSQIndex, queries, k: int, *, n_probes: int = 8,
     block_q: int = 512,
 ) -> Tuple[jax.Array, jax.Array]:

@@ -58,7 +58,11 @@ from raft_tpu.spatial.ann.common import (
     coarse_probe,
     static_qcap,
 )
-from raft_tpu.spatial.ann.ivf_flat import IVFFlatIndex, _grouped_impl
+from raft_tpu.spatial.ann.ivf_flat import (
+    IVFFlatIndex,
+    _grouped_impl,
+    _resolve_scan_engine,
+)
 from raft_tpu.spatial.ann.ivf_pq import (
     IVFPQIndex,
     _encode_block_jit,
@@ -390,8 +394,12 @@ def _mut_search_impl(index, delta, row_mask, q, k, n_probes, qcap,
     f32 = jnp.float32
     qf = q.astype(f32)
     if engine == "flat":
+        # the kernel path masks tombstones at its exact rerank tail
+        # (the in-kernel sub-chunk minima are unmasked — same contract
+        # as the PQ branch below; docs/mutation.md)
         mv, mi = _grouped_impl(
-            index, qf, k, n_probes, qcap, list_block, row_mask=row_mask
+            index, qf, k, n_probes, qcap, list_block, row_mask=row_mask,
+            use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         )
     else:
         mv, mi = _pq_grouped_impl(
@@ -431,7 +439,10 @@ def mutable_search(
     tests/test_mutation.py). ``qcap`` resolves SHAPE-ONLY
     (:func:`...common.static_qcap`) — the mutation tier is a serving
     workload, and the data-dependent auto path would host-sync per
-    dispatch."""
+    dispatch. ``use_pallas`` selects the frozen scan's engine for BOTH
+    index kinds (the PQ ADC kernel / the flat sub-chunk-min kernel);
+    either kernel path applies the tombstone ``row_mask`` at its exact
+    rerank tail — a dead row can crowd a pool slot, never surface."""
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
     errors.check_same_cols(q, mindex.index.centroids, "queries", "index")
@@ -461,10 +472,11 @@ def mutable_search(
             up, jax.default_backend() != "tpu",
         )
         return vals, ids
+    up = _resolve_scan_engine(use_pallas, index.centroids.shape[1], qc)
     vals, ids = _mut_search_impl(
         index, mindex.delta, mindex.row_mask, q, k, n_probes, qc, lb,
         "flat", refine_ratio, exact_selection, approx_recall_target,
-        False, False,
+        up, jax.default_backend() != "tpu",
     )
     if index.metric == "l2":
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))

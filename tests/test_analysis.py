@@ -573,6 +573,157 @@ def test_adc_gather_suppression_honored():
     assert out == []
 
 
+# -- wide-distance-materialize -----------------------------------------------
+
+def test_wide_distance_flags_einsum_tile_into_top_k():
+    # the exact legacy grouped-flat shape: a (LB, qcap, L) einsum tile
+    # massaged through arithmetic + where, then selected over
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def block_fn(qv, mv, qn, mn, invalid, k):
+            dots = jnp.einsum("bqd,bld->bql", qv, mv)
+            d2 = qn[:, :, None] + mn[:, None, :] - 2.0 * dots
+            d2 = jnp.where(invalid, jnp.inf, d2)
+            vals, sel = jax.lax.top_k(-d2, k)
+            return vals
+    """, rule="wide-distance-materialize")
+    assert len(out) == 1
+    assert "einsum distance tile feeds top_k" in out[0].message
+
+
+def test_wide_distance_flags_inline_and_method_chain():
+    # taint through .reshape/.astype chains and straight into approx_min_k
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def scan(lut, onehot, k):
+            d2 = jnp.einsum("bqk,blk->bql", lut, onehot)
+            return jax.lax.approx_min_k(
+                d2.reshape(8, 64, -1).astype(jnp.float32), k
+            )
+    """, rule="wide-distance-materialize")
+    assert len(out) == 1
+
+
+def test_wide_distance_chains_on_call_results(  # review regression
+):
+    """Method chains rooted at a module-alias CALL must re-evaluate the
+    inner call instead of bailing on the module root: taint flows
+    through `einsum(...).astype(...)` and `where(...).reshape(...)`,
+    while `jnp.sum(d2).reshape(...)` still launders."""
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chained_einsum(qv, mv, k):
+            d2 = jnp.einsum("bqd,bld->bql", qv, mv).astype(jnp.float32)
+            return jax.lax.top_k(-d2, k)
+    """, rule="wide-distance-materialize")
+    assert len(out) == 1
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chained_where(qv, mv, m, k):
+            dots = jnp.einsum("bqd,bld->bql", qv, mv)
+            d2 = jnp.where(m, jnp.inf, dots).reshape(8, 64, -1)
+            return jax.lax.top_k(-d2, k)
+    """, rule="wide-distance-materialize")
+    assert len(out) == 1
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def laundered(qv, mv, k):
+            d2 = jnp.einsum("bqd,bld->bql", qv, mv)
+            mins = jnp.min(d2, axis=2).reshape(8, -1)
+            return jax.lax.top_k(-mins, k)
+
+        @jax.jit
+        def laundered_method(qv, mv, k):
+            d2 = jnp.einsum("bqd,bld->bql", qv, mv)
+            return jax.lax.top_k(-d2.min(axis=2), k)
+    """, rule="wide-distance-materialize")
+    assert out == []
+
+
+def test_wide_distance_order_free_taint_fixpoint():
+    """Assignment chains resolve regardless of statement order (the
+    fixpoint, not a single forward pass)."""
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def scan(qv, mv, mask, k):
+            d3 = jnp.where(mask, jnp.inf, d2)
+            d2 = jnp.einsum("bqd,bld->bql", qv, mv)
+            return jax.lax.top_k(-d3, k)
+    """, rule="wide-distance-materialize")
+    assert len(out) == 1
+
+
+def test_wide_distance_narrow_and_reduced_clean():
+    # 2-d scoring einsum (score_l2_candidates shape), a tile consumed by
+    # a reduction, and an untraced body: all clean
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score(cand, qf, k):
+            dots = jnp.einsum("qcd,qd->qc", cand, qf)
+            return jax.lax.top_k(-dots, k)
+
+        @jax.jit
+        def reduced(qv, mv, k):
+            d2 = jnp.einsum("bqd,bld->bql", qv, mv)
+            mins = jnp.min(d2, axis=2)         # reduction launders
+            return jax.lax.top_k(-mins, k)
+
+        def offline(qv, mv, k):                # not traced
+            d2 = jnp.einsum("bqd,bld->bql", qv, mv)
+            return jax.lax.top_k(-d2, k)
+    """, rule="wide-distance-materialize")
+    assert out == []
+
+
+def test_wide_distance_suppression_honored():
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def legacy(qv, mv, k):
+            d2 = jnp.einsum("bqd,bld->bql", qv, mv)
+            return jax.lax.top_k(-d2, k)  # jaxlint: disable=wide-distance-materialize
+    """, rule="wide-distance-materialize")
+    assert out == []
+
+
+def test_wide_distance_legacy_flat_scan_is_baselined():
+    """The one intentional legacy caller — the XLA grouped flat scan
+    kept as the use_pallas=False bit-stable engine — is grandfathered
+    in the committed baseline, and the repo lints clean against it."""
+    result = lint_paths([REPO / "raft_tpu" / "spatial" / "ann"],
+                        root=REPO)
+    flagged = [f for f in result.findings
+               if f.rule == "wide-distance-materialize"]
+    assert [f.path for f in flagged] == \
+        ["raft_tpu/spatial/ann/ivf_flat.py"]
+    base = Baseline.load(REPO / "ci" / "checks" / "jaxlint_baseline.json")
+    new, old = base.filter(flagged)
+    assert new == [] and len(old) == 1
+
+
 # -- mutation-retrace --------------------------------------------------------
 
 def test_mutation_retrace_flags_int_coercion():

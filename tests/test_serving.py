@@ -813,3 +813,113 @@ def test_round7_bench_line_parses_with_mixed_ingest():
                     if r.get("scenario") == "mixed_ingest")
         assert mrow["qps_ratio_vs_frozen"] == 0.865
         assert "mixed_search_qps" in mrow
+
+
+def test_round10_bench_line_parses_with_flat_scan_kernel():
+    """ISSUE 10 satellite (the _fit_line parse/cap test extended,
+    following the r05-r09 pattern): the round-10 artifact shape — every
+    prior row PLUS the flat_scan_kernel acceptance row and the
+    ``scan_engine`` stamp on the flat shard row — must print as a line
+    that json.loads-round-trips under the 1800-char driver cap, with
+    the acceptance keys (kernel-vs-XLA speedup, the engine stamp,
+    recall at both engines' operating point) surviving every trim
+    stage short of the last-resort core projection."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r10", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    serving_rows = [
+        {"engine": e, "nq": nq, "p50_ms": 1.2345, "spread": 0.08,
+         "repeats": 5, "qcap": 24}
+        for e in ("fused_knn", "ivf_flat", "ivf_pq")
+        for nq in (1, 128, 1024)
+    ] + [
+        {"engine": "ivf_flat", "scenario": "mixed_ingest", "nq": 128,
+         "frozen_qps": 52000.0, "ingest_qps": 310000.0,
+         "mixed_search_qps": 45000.0, "spread": 0.06, "repeats": 5,
+         "qps_ratio_vs_frozen": 0.865, "upsert_visible_ms": 4.2,
+         "delete_masked_ms": 2.9},
+        {"engine": "ivf_flat", "scenario": "open_loop", "nq": 1024,
+         "program_qps": 610000.0, "saturation_qps": 512000.0,
+         "qps_ratio_vs_program": 0.839, "spread": 0.04, "repeats": 5,
+         "p50_ms_95": 4.2, "p99_ms_95": 14.6, "shed_rate_95": 0.012},
+    ]
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01,
+         "vs_prev_qcap8_qps": 0.99, "vs_prev_build_warm_s": 1.0}
+        for i in range(7)
+    ] + [
+        # the round-10 acceptance row, every key extra_flat_scan_kernel
+        # emits
+        {"metric": "flat_scan_kernel_500000x96_q4096_k10_p16",
+         "value": 104321.5, "unit": "QPS", "spread": 0.04, "repeats": 7,
+         "escalations": 1, "scan_engine": "pallas",
+         "recall_at_10": 0.9994, "xla_qps": 50620.9,
+         "xla_recall_at_10": 0.9994, "xla_spread": 0.05,
+         "speedup": 2.06, "vs_prev": 1.0, "vs_prev_xla_qps": 1.0},
+        # the flat 100M-shard row now stamps its scan engine
+        {"metric": "mnmg_ivf_flat_shard_12500000x96_q16384_k10_p16",
+         "value": 50620.9, "unit": "QPS", "spread": 0.014, "repeats": 7,
+         "escalations": 1, "scan_engine": "pallas",
+         "recall_at_10_vs_shard": 0.9994, "build_s": 180.0,
+         "qcap8_qps": 130789.3, "measured_chip_qps": 1.2e5,
+         "sharded_e2e_qps": 1.1e5, "probe_recall_vs_flat": 0.997,
+         "probe_flop_ratio": 5.2, "vs_prev": 1.05},
+        {"metric": "mnmg_cross_host_131072x64_q512_k10_hostsim_2x4",
+         "value": 48123.4, "unit": "QPS", "spread": 0.07,
+         "flat_e2e_qps": 50620.9, "qps_ratio_vs_flat": 0.951,
+         "wire": "bf16", "dcn_bytes_ratio": 3.2,
+         "health_flip_retraces": 0, "coverage_host_down": 1.0,
+         "host_down_bitident": True},
+        {"metric": "serving_p50_500000x96_k10_p16", "unit": "ms",
+         "rows": serving_rows},
+        {"metric": "warm_start_build_500000x96", "unit": "s",
+         "value": 3.1, "build_warm_s": 1.9, "within_2x_warm": True},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    krow = next((e for e in parsed["extras"]
+                 if str(e.get("metric", "")).startswith(
+                     "flat_scan_kernel")), None)
+    assert krow is not None
+    assert krow["value"] == 104321.5        # primary survives any trim
+    # the acceptance keys are not in _TRIM_ORDER and print whitelisted,
+    # so they only fall at the last-resort _core_projection
+    if "speedup" in krow:                   # not core-projected
+        assert krow["speedup"] == 2.06
+        assert krow["scan_engine"] == "pallas"
+        assert krow["recall_at_10"] == 0.9994
+    for key in ("speedup", "scan_engine", "recall_at_10"):
+        assert key not in benchtop._TRIM_ORDER
+        assert key in benchtop._PRINT_KEYS
+    # xla_qps IS trimmable (speedup carries the acceptance signal), and
+    # it is companion-tracked round-over-round
+    assert "xla_qps" in benchtop._TRIM_ORDER
+    assert "xla_qps" in benchtop._COMPANIONS
+    # the rows' _compact projections always carry the stamps pre-trim
+    c = benchtop._compact(extras[7])
+    for key in ("value", "scan_engine", "speedup", "xla_qps",
+                "xla_recall_at_10"):
+        assert key in c, key
+    assert benchtop._compact(extras[8])["scan_engine"] == "pallas"
