@@ -396,6 +396,110 @@ def _scan_engine(index, nq, n_probes, *, qcap):
     ) else "xla"
 
 
+def _sq_scan_engine(index, nq, n_probes, *, qcap):
+    """Which SQ scan engine the row's grouped search resolves to
+    ("pallas" = the int8 in-kernel dequant+scan, "xla" = the dequant
+    scan) — the SQ sibling of ``_scan_engine``, same real-qcap
+    discipline."""
+    from raft_tpu.spatial.ann.common import static_qcap
+    from raft_tpu.spatial.ann.ivf_sq import _resolve_sq_engine
+
+    return "pallas" if _resolve_sq_engine(
+        None, index.centroids.shape[1],
+        static_qcap(qcap, nq, n_probes, index.centroids.shape[0]),
+    ) else "xla"
+
+
+def _probe_kernel(index, nq, n_probes, engine_stamp, *,
+                  overprobe: float = 2.0):
+    """Whether the fused serving rows' two-level coarse probe runs
+    through the shared scan-kernel core ("pallas") or the legacy tile
+    path ("xla") — stamped on the shard rows so the driver can verify
+    the probe-kernelization (ISSUE 11) was actually active. The probe
+    kernel rides the engines' use_pallas static, so it engages exactly
+    when the engine stamp says "pallas" AND the probe geometry fits
+    the shared planner."""
+    from raft_tpu.spatial.ann.common import (
+        n_super_probes, two_level_probe_kernel_supported,
+    )
+
+    c = getattr(index, "coarse", None)
+    if engine_stamp != "pallas" or c is None:
+        return "xla"
+    S = n_super_probes(n_probes, c.n_super, overprobe)
+    return "pallas" if two_level_probe_kernel_supported(
+        index.centroids.shape[1], nq, n_probes, c.n_super,
+        c.max_members, S,
+    ) else "xla"
+
+
+def extra_sq_scan_kernel():
+    """Single-chip grouped IVF-SQ: the XLA dequant scan vs the int8
+    Pallas dequant+scan kernel (spatial/ann/sq_kernel) at the shared
+    500k x 96 config — the ISSUE 11 acceptance row (>= 3x at equal
+    recall on this geometry). ``value`` is the auto-engine QPS (the
+    kernel on TPU), ``xla_qps`` the pinned ``use_pallas=False`` dequant
+    engine on the SAME index and queries, ``speedup`` their ratio;
+    recall@10 for BOTH engines against the exact oracle so "equal
+    recall" is measured, not assumed. On a non-TPU backend auto
+    resolves to the XLA engine and the row degenerates to speedup ~1."""
+    from raft_tpu.spatial.ann import IVFSQParams, ivf_sq_build
+    from raft_tpu.spatial.ann.ivf_sq import ivf_sq_search_grouped
+    from bench.common import (
+        ann_bench_dataset, chained_dispatch_stats, recall_at_k,
+    )
+
+    n, d, nq, k = 500_000, 96, 4096, 10
+    x, q, true_np = ann_bench_dataset(n, d, nq, k)
+    # same capped list geometry as the flat acceptance row so the two
+    # engines' rows read side-by-side (docs/ivf_scale.md)
+    idx = ivf_sq_build(x, IVFSQParams(
+        n_lists=2048, kmeans_n_iters=10, max_list_cap=512,
+    ))
+    float(jnp.sum(idx.centroids))
+    n_probes = 16
+
+    def make(up):
+        def search(qq):
+            return ivf_sq_search_grouped(
+                idx, qq, k, n_probes=n_probes, qcap="throughput",
+                use_pallas=up,
+            )
+        return search
+
+    stats = {}
+    for label, up in (("auto", None), ("xla", False)):
+        fn = make(up)
+        float(jnp.sum(fn(q)[0]))            # compile + warm
+        st = chained_dispatch_stats(
+            lambda salt: q * (1.0 + 1e-6 * salt), fn, escalate=1,
+        )
+        if st is None:
+            return {"metric": "sq_scan_kernel",
+                    "error": f"{label} timing jitter-dominated"}
+        stats[label] = (st, recall_at_k(fn(q)[1], true_np))
+    st, rec = stats["auto"]
+    st_x, rec_x = stats["xla"]
+    qps = nq / (st["ms"] / 1e3)
+    xla_qps = nq / (st_x["ms"] / 1e3)
+    return {
+        "metric": f"sq_scan_kernel_{n}x{d}_q{nq}_k{k}_p{n_probes}",
+        "value": round(qps, 1),
+        "unit": "QPS",
+        "spread": st["spread"],
+        "repeats": st["repeats"],
+        "escalations": st.get("escalations", 0),
+        "scan_engine": _sq_scan_engine(idx, nq, n_probes,
+                                       qcap="throughput"),
+        "recall_at_10": round(rec, 4),
+        "xla_qps": round(xla_qps, 1),
+        "xla_recall_at_10": round(rec_x, 4),
+        "xla_spread": st_x["spread"],
+        "speedup": round(qps / xla_qps, 2),
+        "index_gb": round(idx.codes_sorted.nbytes / 1e9, 2),
+    }
+
+
 def extra_flat_scan_kernel():
     """Single-chip grouped IVF-Flat: the XLA scan vs the Pallas
     sub-chunk-min flat kernel (spatial/ann/flat_kernel) at the shared
@@ -935,10 +1039,15 @@ def _mnmg_shard_100m_impl(engine: str):
         # one-dispatch serving rows
         out["adc_engine"] = _adc_engine(idx, nq, 16, qcap="throughput",
                                          refine_ratio=8.0)
+        engine_stamp = out["adc_engine"]
     else:
         # the flat sibling stamp: which scan engine the shard-local
         # grouped search inside the fused program resolved to
         out["scan_engine"] = _scan_engine(idx, nq, 16, qcap="throughput")
+        engine_stamp = out["scan_engine"]
+    # ISSUE 11: whether the fused rows' two-level probe ran through the
+    # shared scan-kernel core (it rides the engine's use_pallas static)
+    out["probe_kernel"] = _probe_kernel(eidx, nq, 16, engine_stamp)
     out["n_probe_cents"] = n_gcents
     out["probe_flop_ratio"] = round(flops["ratio"], 2)
     out["probe_recall_vs_flat"] = round(probe_rec, 4)
@@ -1064,6 +1173,7 @@ _EXTRAS = {
     "kmeans": extra_kmeans,
     "ivf_pq": extra_ivf_pq,
     "flat_scan_kernel": extra_flat_scan_kernel,
+    "sq_scan_kernel": extra_sq_scan_kernel,
     "ivf_pq_10m": extra_ivf_pq_10m,
     "mnmg_ivf_pq": extra_mnmg_ivf_pq,
     "mnmg_shard_100m": extra_mnmg_shard_100m,
@@ -1188,9 +1298,12 @@ def _stamp_vs_prev(row, prev):
 _PRINT_KEYS = {
     "metric", "value", "unit", "spread", "repeats", "escalations",
     "error", "adc_engine",
-    # the flat scan-engine stamp + the flat_scan_kernel acceptance row
-    # (ISSUE 10): kernel-vs-XLA QPS on one index, recall both engines
+    # the flat/SQ scan-engine stamp + the flat_scan_kernel/sq_scan_kernel
+    # acceptance rows (ISSUES 10/11): kernel-vs-XLA QPS on one index,
+    # recall both engines; probe_kernel stamps whether the shard rows'
+    # two-level probe ran through the shared scan-kernel core
     "scan_engine", "xla_qps", "xla_recall_at_10", "speedup",
+    "probe_kernel",
     "recall_at_10", "recall_at_10_vs_shard", "build_s", "build_warm_s",
     "bf16_iters_per_s", "f32_highest_gflops", "vs_baseline",
     "brute_force_same_shape_qps", "measured_chip_qps", "qcap8_qps",
@@ -1237,7 +1350,7 @@ _RETIRED_KEYS = ("probe_global_ms", "projected_100m_qps", "merge8_ms")
 # and a trimmed-but-parsing line beats a complete-but-unparsed one
 _TRIM_ORDER = (
     "repeats", "within_2x_warm", "escalations", "probe_flop_ratio",
-    "build_warm_s",
+    "probe_kernel", "build_warm_s",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",
@@ -1317,7 +1430,7 @@ def _compact(row):
             continue
         if isinstance(v, str) and key not in (
             "metric", "unit", "error", "engine", "scenario",
-            "adc_engine", "scan_engine", "wire"
+            "adc_engine", "scan_engine", "probe_kernel", "wire"
         ):
             continue
         if isinstance(v, list) and v and isinstance(v[0], dict):

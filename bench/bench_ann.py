@@ -158,6 +158,7 @@ def main():
 
     bench_pq_adc_kernel()
     bench_flat_scan_kernel()
+    bench_sq_scan_kernel()
 
 
 def bench_flat_scan_kernel():
@@ -221,6 +222,66 @@ def bench_flat_scan_kernel():
         rec[f"{label}_escalations"] = st.get("escalations", 0)
     if "xla_ms" in rec and "pallas_ms" in rec:
         rec["speedup"] = round(rec["xla_ms"] / rec["pallas_ms"], 2)
+    print(json.dumps(rec))
+
+
+def bench_sq_scan_kernel():
+    """The int8 SQ scan-block microbench (ISSUE 11): the XLA dequant
+    scan — a full-width f32 dequant expansion of every slab block
+    through HBM feeding a materialized distance tile — vs the Pallas
+    in-kernel dequant+scan (spatial/ann/sq_kernel, on the shared
+    scan-kernel core), at FIXED shapes so the kernel speedup is tracked
+    independently of the e2e SQ QPS row in bench.py. The lax baseline
+    here is the kernel's own op-for-op mirror: same bf16 rounding of
+    the dequantized tile, so the comparison isolates the memory-path
+    win (int8 crosses HBM at one byte/element and expands only in
+    VMEM). Spread-escalated via the shared chained-dispatch harness;
+    on a non-TPU backend the kernel runs in interpret mode and the
+    comparison is semantics-only."""
+    import functools
+
+    from raft_tpu.spatial.ann import sq_kernel
+
+    LB, L, d, Q = 8, 2048, 96, 48
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(11)
+    qv = jax.device_put(rng.standard_normal((LB, Q, d)).astype(np.float32))
+    codes_t = jax.device_put(
+        rng.integers(-128, 128, (LB, d, L)).astype(np.int8)
+    )
+    bounds = jnp.tile(jnp.asarray([[0, L]], jnp.int32), (LB, 1))
+    vmin = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    vscale = jnp.full((d,), 1.0 / 64.0, jnp.float32)
+
+    @jax.jit
+    def lax_block(q_in):
+        return sq_kernel.sq_scan_subchunk_min_lax(
+            q_in, codes_t, bounds, vmin, vscale
+        )
+
+    l_tile = sq_kernel.plan_l_tile(d, Q)       # the tile the impl plans
+
+    @functools.partial(jax.jit, static_argnames=("interp",))
+    def kernel_block(q_in, interp=interpret):
+        return sq_kernel.sq_scan_subchunk_min(
+            q_in, codes_t, bounds, vmin, vscale,
+            interpret=interp, l_tile=l_tile,
+        )
+
+    rec = {"name": f"ann/sq_scan_kernel/LB{LB}xL{L}xd{d}q{Q}"}
+    for label, fn in (("lax", lax_block), ("pallas", kernel_block)):
+        jax.block_until_ready(fn(qv))
+        st = chained_dispatch_stats(
+            lambda salt: qv * (1.0 + 1e-6 * salt), fn, escalate=1,
+        )
+        if st is None:
+            rec[f"{label}_note"] = "jitter-dominated"
+            continue
+        rec[f"{label}_ms"] = round(st["ms"], 3)
+        rec[f"{label}_spread"] = st["spread"]
+        rec[f"{label}_escalations"] = st.get("escalations", 0)
+    if "lax_ms" in rec and "pallas_ms" in rec:
+        rec["speedup"] = round(rec["lax_ms"] / rec["pallas_ms"], 2)
     print(json.dumps(rec))
 
 

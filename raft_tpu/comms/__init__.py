@@ -35,9 +35,13 @@ from raft_tpu.comms.mnmg_ivf import (
 )
 from raft_tpu.comms.mnmg_ivf_flat import (
     MnmgIVFFlatIndex,
+    MnmgIVFSQIndex,
     mnmg_ivf_flat_build,
     mnmg_ivf_flat_build_distributed,
     mnmg_ivf_flat_search,
+    mnmg_ivf_sq_build,
+    mnmg_ivf_sq_build_distributed,
+    mnmg_ivf_sq_search,
 )
 from raft_tpu.comms.multihost import (
     comms_levels,
@@ -80,6 +84,10 @@ __all__ = [
     "mnmg_ivf_flat_build",
     "mnmg_ivf_flat_build_distributed",
     "mnmg_ivf_flat_search",
+    "MnmgIVFSQIndex",
+    "mnmg_ivf_sq_build",
+    "mnmg_ivf_sq_build_distributed",
+    "mnmg_ivf_sq_search",
     "comms_levels",
     "dcn_merge_accounting",
     "hierarchical_merge_select_k",

@@ -1240,10 +1240,16 @@ def _cached_search(
         # replicated compute: identical global probes on every chip —
         # queries never move, only the (nq, k) results do
         if use_coarse:
+            # use_pallas (the same static that selects the shard-local
+            # scan engine) also kernelizes the probe stage: both of the
+            # two-level probe's distance tiles stay in VMEM inside this
+            # fused program (scan_core; auto-degrades to the legacy
+            # probe when the probe geometry does not fit the plan)
             probes_g, _ = two_level_probe(
                 qf, sup_c, mem_i, cpad, owner.shape[0], n_probes,
                 n_super_probes(n_probes, sup_c.shape[0], overprobe),
-                _PROBE_BLOCK_Q,
+                _PROBE_BLOCK_Q, use_pallas=use_pallas,
+                pallas_interpret=pallas_interpret,
             )
         else:
             probes_g, _ = coarse_probe(qf, cents, n_probes)  # (nq, p)

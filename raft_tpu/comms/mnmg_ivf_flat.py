@@ -80,8 +80,10 @@ from raft_tpu.spatial.ann.ivf_flat import (
 )
 
 __all__ = [
-    "MnmgIVFFlatIndex", "mnmg_ivf_flat_build",
+    "MnmgIVFFlatIndex", "MnmgIVFSQIndex", "mnmg_ivf_flat_build",
     "mnmg_ivf_flat_build_distributed", "mnmg_ivf_flat_search",
+    "mnmg_ivf_sq_build", "mnmg_ivf_sq_build_distributed",
+    "mnmg_ivf_sq_search",
 ]
 
 
@@ -228,29 +230,8 @@ def mnmg_ivf_flat_build_distributed(
     cents = coarse.centroids
 
     # ---- phase 2: per-rank blocked assignment + global list sizes
-    B = max(1, min(nloc, 1 << 20))
-    nb = _cdiv_host(nloc, B)
-
-    def asg_body(x_sh, nv_sh, cents_in):
-        xb, nvr = x_sh[0], nv_sh[0]
-        xp = jnp.pad(xb, ((0, nb * B - nloc), (0, 0)))
-        lbl = lax.map(
-            lambda blk: kmeans_predict(blk, cents_in).astype(jnp.int32),
-            xp.reshape(nb, B, d),
-        ).reshape(-1)[:nloc]
-        valid = jnp.arange(nloc, dtype=jnp.int32) < nvr
-        cnt = jnp.zeros((nl + 1,), jnp.int32).at[
-            jnp.where(valid, lbl, nl)
-        ].add(1)[:nl]
-        return lbl[None], ax.allgather(cnt)
-
-    lbl_g, C = _cached_program(
-        ("asg", comms.mesh, comms.axis, Pn, nloc, d, B, nb, nl,
-         str(x.dtype)),
-        lambda: jax.jit(comms.shard_map(
-            asg_body, in_specs=(sh3, sh1, rep), out_specs=(sh2, rep),
-        )),
-    )(x, n_valid, cents)
+    # (shared with the SQ build — one assignment program authority)
+    lbl_g, C = _assign_lists(comms, x, n_valid, cents, nl)
 
     cap = (
         params.max_list_cap
@@ -280,6 +261,42 @@ def mnmg_ivf_flat_build_distributed(
     return place_index(comms, host)
 
 
+def _assign_lists(comms: Comms, x, n_valid, cents, nl: int):
+    """Phase 2 of the flat-family distributed builds (Flat and SQ):
+    per-rank blocked nearest-centroid assignment + one allgather of the
+    local bincounts. Returns (lbl_g (P, n_loc) sharded, C (P, nl)
+    replicated count matrix)."""
+    Pn, nloc, d = x.shape
+    ax = comms.device_comms()
+    sh3 = _P3(comms.axis)
+    sh1 = P(comms.axis)
+    sh2 = P(comms.axis, None)
+    rep = P()
+    B = max(1, min(nloc, 1 << 20))
+    nb = _cdiv_host(nloc, B)
+
+    def asg_body(x_sh, nv_sh, cents_in):
+        xb, nvr = x_sh[0], nv_sh[0]
+        xp = jnp.pad(xb, ((0, nb * B - nloc), (0, 0)))
+        lbl = lax.map(
+            lambda blk: kmeans_predict(blk, cents_in).astype(jnp.int32),
+            xp.reshape(nb, B, d),
+        ).reshape(-1)[:nloc]
+        valid = jnp.arange(nloc, dtype=jnp.int32) < nvr
+        cnt = jnp.zeros((nl + 1,), jnp.int32).at[
+            jnp.where(valid, lbl, nl)
+        ].add(1)[:nl]
+        return lbl[None], ax.allgather(cnt)
+
+    return _cached_program(
+        ("asg", comms.mesh, comms.axis, Pn, nloc, d, B, nb, nl,
+         str(x.dtype)),
+        lambda: jax.jit(comms.shard_map(
+            asg_body, in_specs=(sh3, sh1, rep), out_specs=(sh2, rep),
+        )),
+    )(x, n_valid, cents)
+
+
 @functools.lru_cache(maxsize=32)
 def _cached_search(
     mesh: jax.sharding.Mesh, axis: str, statics: tuple,
@@ -300,7 +317,7 @@ def _cached_search(
     (k, n_probes, qcap, list_block, n_pad, nl_pad, max_list,
      use_coarse, overprobe, merge_ways, replication,
      replica_offset, use_pallas, pallas_interpret, rerank_ratio,
-     wire) = statics
+     wire, sq) = statics
     comms = Comms(mesh=mesh, axis=axis)
     ax = comms.device_comms()
     n_ranks = comms.size
@@ -313,6 +330,15 @@ def _cached_search(
         (cents, owner, local_id, lcents, vecs_s, sids, loffs, lszs,
          q, sup_c, mem_i, cpad) = opnds[:12]
         rest = list(opnds[12:])
+        dequant = None
+        if sq:
+            # the SQ mode of the one fused body (ISSUE 11): vecs_s holds
+            # int8 QT_8bit codes and the replicated affine pair rides as
+            # two extra runtime operands — the shard-local scan routes
+            # through the int8 in-kernel dequant+scan engine when
+            # use_pallas holds (spatial/ann/sq_kernel)
+            dequant = (rest[0], rest[1])
+            rest = rest[2:]
         alive = route = None
         if degraded:
             alive, route = rest[0], rest[1]
@@ -331,10 +357,16 @@ def _cached_search(
             qf, row_valid = sanitize_query_rows(qf)
         # replicated compute: identical global probes on every chip
         if use_coarse:
+            # use_pallas (the shard-local scan-engine static) also
+            # kernelizes the probe stage through the shared core —
+            # neither probe tile materializes inside the fused program
+            # (auto-degrades to the legacy probe when the probe
+            # geometry does not fit the plan)
             probes_g, _ = two_level_probe(
                 qf, sup_c, mem_i, cpad, owner.shape[0], n_probes,
                 n_super_probes(n_probes, sup_c.shape[0], overprobe),
-                _PROBE_BLOCK_Q,
+                _PROBE_BLOCK_Q, use_pallas=use_pallas,
+                pallas_interpret=pallas_interpret,
             )
         else:
             probes_g, _ = coarse_probe(qf, cents, n_probes)  # (nq, p)
@@ -386,7 +418,7 @@ def _cached_search(
             shard, qf, k, n_probes, qcap, list_block, probes=lp,
             row_mask=rm_s[0] if mutation else None,
             use_pallas=use_pallas, pallas_interpret=pallas_interpret,
-            rerank_ratio=rerank_ratio,
+            rerank_ratio=rerank_ratio, dequant=dequant,
         )
         if mutation:
             from raft_tpu.comms.mnmg_ivf import _merge_local_delta
@@ -422,6 +454,8 @@ def _cached_search(
         sharded3, sharded3, sharded2, sharded2, sharded2, rep2,
         rep2, rep2, rep3,           # coarse: super_cents, member_ids, pad
     )
+    if sq:
+        in_specs = in_specs + (P(None), P(None))     # vmin, vscale
     out_specs = (rep2, rep2)
     if degraded:
         in_specs = in_specs + (P(None), P(None))     # alive, route
@@ -507,6 +541,41 @@ def mnmg_ivf_flat_search(
     trace-audited with the kernel engaged). The mutation tier's
     ``row_mask`` folds in at the kernel path's exact rerank tail.
     """
+    out = _flat_family_search(
+        comms, index, queries, k, sq=False, n_probes=n_probes,
+        qcap=qcap, list_block=list_block,
+        qcap_max_drop_frac=qcap_max_drop_frac,
+        donate_queries=donate_queries, shard_mask=shard_mask,
+        failover=failover, overprobe=overprobe, merge_ways=merge_ways,
+        mutation=mutation, wire=wire, use_pallas=use_pallas,
+        rerank_ratio=rerank_ratio,
+    )
+    if index.metric != "l2":
+        return out
+    # sqrt after the merge; +inf slots (down shards, invalid rows) on
+    # the degraded path stay +inf
+    if isinstance(out, PartialSearchResult):
+        return dataclasses.replace(
+            out, distances=jnp.sqrt(jnp.maximum(out.distances, 0.0))
+        )
+    vals, ids = out
+    return jnp.sqrt(jnp.maximum(vals, 0.0)), ids
+
+
+def _flat_family_search(
+    comms: Comms, index, queries, k: int, *, sq: bool, n_probes,
+    qcap, list_block, qcap_max_drop_frac, donate_queries, shard_mask,
+    failover, overprobe, merge_ways, mutation, wire, use_pallas,
+    rerank_ratio,
+):
+    """The ONE serving wrapper behind :func:`mnmg_ivf_flat_search` and
+    :func:`mnmg_ivf_sq_search`: validation chain, engine resolution,
+    the ``_cached_search`` statics tuple (position-coupled to the body's
+    unpack — ONE authority so the two engines can never drift), operand
+    assembly (``sq=True`` appends the replicated affine pair and serves
+    the int8 code slab in the ``vectors_sorted`` operand slot), and the
+    degraded/failover tail. Returns squared distances; the flat wrapper
+    applies its metric sqrt on top."""
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
     errors.check_same_cols(q, index.centroids, "queries", "index")
@@ -532,11 +601,18 @@ def mnmg_ivf_flat_search(
         overprobe=overprobe,
     )
     list_block = max(1, min(list_block, index.nl_pad))
-    from raft_tpu.spatial.ann.ivf_flat import _resolve_scan_engine
+    if sq:
+        from raft_tpu.spatial.ann.ivf_sq import _resolve_sq_engine
 
-    use_pallas = _resolve_scan_engine(
-        use_pallas, index.centroids.shape[1], qcap
-    )
+        use_pallas = _resolve_sq_engine(
+            use_pallas, index.centroids.shape[1], qcap
+        )
+    else:
+        from raft_tpu.spatial.ann.ivf_flat import _resolve_scan_engine
+
+        use_pallas = _resolve_scan_engine(
+            use_pallas, index.centroids.shape[1], qcap
+        )
     statics = (
         k, n_probes, qcap, list_block, index.n_pad, index.nl_pad,
         index.max_list,
@@ -547,6 +623,7 @@ def mnmg_ivf_flat_search(
         # wire only shapes 2-level programs; normalized to None on a
         # 1-level mesh so the flat program's cache key never splits
         wire if n_hosts > 1 else None,
+        sq,
     )
     degraded = shard_mask is not None
     errors.expects(
@@ -564,16 +641,19 @@ def mnmg_ivf_flat_search(
     sup_c, mem_i, cpad = _coarse_probe_operands(
         index, index.centroids.shape[1]
     )
+    slab = index.codes_sorted if sq else index.vectors_sorted
     args = (
         index.centroids, index.owner, index.local_id, index.local_cents,
-        index.vectors_sorted, index.sorted_ids, index.list_offsets,
+        slab, index.sorted_ids, index.list_offsets,
         index.list_sizes, q, sup_c, mem_i, cpad,
     )
+    if sq:
+        args = args + (
+            jnp.asarray(index.vmin, jnp.float32),
+            jnp.asarray(index.vscale, jnp.float32),
+        )
     if not degraded:
-        vals, ids = fn(*args, *(mut_args or ()))
-        if index.metric == "l2":
-            vals = jnp.sqrt(jnp.maximum(vals, 0.0))
-        return vals, ids
+        return fn(*args, *(mut_args or ()))
     alive = resolve_shard_mask(shard_mask, comms.size)
     route = resolve_route(
         failover, comms.size, int(index.replication),
@@ -582,10 +662,259 @@ def mnmg_ivf_flat_search(
     md, mi, cov, rv = fn(
         *args, jnp.asarray(alive), jnp.asarray(route), *(mut_args or ())
     )
-    if index.metric == "l2":
-        # sqrt after the merge, exactly as the healthy path; +inf slots
-        # (down shards, invalid rows) stay +inf
-        md = jnp.sqrt(jnp.maximum(md, 0.0))
     return PartialSearchResult(
         distances=md, ids=mi, coverage=cov, row_valid=rv
+    )
+
+
+# --------------------------------------------------------------- IVF-SQ
+@compat.register_dataclass
+@dataclasses.dataclass
+class MnmgIVFSQIndex:
+    """List-sharded int8 IVF-SQ index over a comms mesh — the SQ mode of
+    the one fused flat-family serving program (ISSUE 11): field names
+    shared with :class:`MnmgIVFFlatIndex`/``MnmgIVFPQIndex`` so the
+    placement/replication/reshard/serialization machinery applies
+    unchanged, with ``codes_sorted`` holding int8 QT_8bit codes (HALF
+    the bf16 flat slab footprint — the win that compounds with the
+    billion-vector budget math, docs/ivf_scale.md) and the replicated
+    affine dequant pair ``vmin``/``vscale`` riding as runtime operands
+    of the fused search."""
+
+    centroids: jax.Array       # (n_lists_g, d) replicated
+    owner: jax.Array           # (n_lists_g,) int32 — owning rank per list
+    local_id: jax.Array        # (n_lists_g,) int32 — list id on its owner
+    local_cents: jax.Array     # (P, nl_pad, d) — per-chip centroid slab
+    codes_sorted: jax.Array    # (P, n_pad + 1, d) int8, list-sorted
+    vmin: jax.Array            # (d,) f32 replicated affine offset
+    vscale: jax.Array          # (d,) f32 replicated affine scale
+    sorted_ids: jax.Array      # (P, n_pad) int32 GLOBAL row ids
+    list_offsets: jax.Array    # (P, nl_pad + 1) int32
+    list_sizes: jax.Array      # (P, nl_pad) int32
+    n_pad: int = dataclasses.field(metadata=dict(static=True))
+    nl_pad: int = dataclasses.field(metadata=dict(static=True))
+    max_list: int = dataclasses.field(metadata=dict(static=True))
+    n_rows: int = dataclasses.field(metadata=dict(static=True))
+    # R-way striped replica layout — see MnmgIVFPQIndex
+    replication: int = dataclasses.field(
+        default=1, metadata=dict(static=True)
+    )
+    replica_offset: int = dataclasses.field(
+        default=1, metadata=dict(static=True)
+    )
+    # present (always None) so reshard/replicate treat the SQ index
+    # through the same field protocol as its siblings
+    vectors_sorted: typing.Optional[jax.Array] = None
+    # optional two-level coarse quantizer over the GLOBAL probe set
+    coarse: typing.Optional[CoarseIndex] = None
+
+    def warmup(self, comms: "Comms", nq: int, *, k: int = 10,
+               n_probes: int = 8, qcap=None, list_block: int = 32,
+               donate_queries: bool = False, shard_mask=None,
+               failover=None, overprobe: float = 2.0,
+               merge_ways: typing.Optional[int] = None,
+               mutation=None, wire: str = "bf16",
+               use_pallas: typing.Optional[bool] = None,
+               rerank_ratio: float = 4.0) -> int:
+        """Pre-compile the sharded SQ serving program for (nq, d)
+        float32 batches — the SQ sibling of
+        :meth:`MnmgIVFFlatIndex.warmup` (one all-zeros batch through
+        :func:`mnmg_ivf_sq_search`, blocked on). Returns the
+        shape-only-resolved qcap; pass exactly that integer (and the
+        same ``donate_queries``) on serving dispatches."""
+        from raft_tpu.spatial.ann.common import static_qcap
+
+        qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
+        q0 = jnp.zeros((nq, self.centroids.shape[1]), jnp.float32)
+        out = mnmg_ivf_sq_search(
+            comms, self, q0, k, n_probes=n_probes, qcap=qc,
+            list_block=list_block, donate_queries=donate_queries,
+            shard_mask=shard_mask, failover=failover,
+            overprobe=overprobe, merge_ways=merge_ways,
+            mutation=mutation, wire=wire, use_pallas=use_pallas,
+            rerank_ratio=rerank_ratio,
+        )
+        jax.block_until_ready(out)
+        return qc
+
+
+def mnmg_ivf_sq_build(
+    comms: Comms, x, params=None,
+) -> MnmgIVFSQIndex:
+    """One-host convenience wrapper: row-shard ``x`` onto the mesh
+    (:func:`shard_rows`) and run the per-rank distributed SQ build."""
+    from raft_tpu.spatial.ann.ivf_sq import IVFSQParams
+
+    x = np.asarray(x)
+    errors.expects(
+        x.ndim == 2 and x.shape[0] >= 2,
+        "x: expected a (n >= 2, d) matrix, got shape %s", tuple(x.shape),
+    )
+    xg, n_valid = shard_rows(comms, x)
+    return mnmg_ivf_sq_build_distributed(
+        comms, xg, params if params is not None else IVFSQParams(),
+        n_valid=n_valid,
+    )
+
+
+def mnmg_ivf_sq_build_distributed(
+    comms: Comms, x, params=None, *, n_valid=None,
+) -> MnmgIVFSQIndex:
+    """Build a list-sharded int8 IVF-SQ index from PER-RANK row shards —
+    the SQ sibling of :func:`mnmg_ivf_flat_build_distributed` (same
+    input convention and phase pipeline): collective subsample ->
+    replicated coarse k-means -> per-rank blocked assignment (the SHARED
+    :func:`_assign_lists` program) -> a collective masked min/max pass
+    for the QT_8bit affine stats -> per-rank int8 encode -> the shared
+    distributed list assembly with the int8 codes as the exchange
+    payload (``_exchange_and_assemble`` carries them at one byte per
+    dimension — the same wire thrift as the serving-side slab win)."""
+    from raft_tpu.spatial.ann.ivf_sq import IVFSQParams
+
+    if params is None:
+        params = IVFSQParams()
+    errors.expects(
+        hasattr(x, "ndim") and x.ndim == 3,
+        "x: expected (n_ranks, n_loc, d) stacked row shards, got %s",
+        tuple(getattr(x, "shape", ())),
+    )
+    Pn, nloc, d = x.shape
+    errors.expects(
+        Pn == comms.size,
+        "x leading axis %d != mesh size %d", Pn, comms.size,
+    )
+    if n_valid is None:
+        n_valid = np.full(Pn, nloc, np.int32)
+    n_valid = np.asarray(n_valid, np.int32)
+    n = int(n_valid.sum())
+    errors.check_k(params.n_lists, n, "n_lists vs dataset rows")
+    nl = params.n_lists
+    ax = comms.device_comms()
+    sh3 = _P3(comms.axis)
+    sh1 = P(comms.axis)
+    rep = P()
+
+    # ---- phase 1: collective subsample -> replicated coarse quantizer
+    _, coarse = _train_coarse_distributed(
+        comms, x, n_valid, n, nl, None,
+        params.kmeans_n_iters, "k-means++", params.seed,
+    )
+    cents = coarse.centroids
+
+    # ---- phase 2: shared per-rank blocked assignment
+    lbl_g, C = _assign_lists(comms, x, n_valid, cents, nl)
+
+    # ---- phase 2b: QT_8bit affine stats — per-rank masked min/max +
+    # one allgather reduce (padding rows beyond n_valid are neutralized,
+    # so ragged shards cannot drag the range toward zero)
+    def stats_body(x_sh, nv_sh):
+        xb, nvr = x_sh[0].astype(jnp.float32), nv_sh[0]
+        valid = (jnp.arange(nloc, dtype=jnp.int32) < nvr)[:, None]
+        big = jnp.float32(3.4e38)
+        mn = jnp.min(jnp.where(valid, xb, big), axis=0)
+        mx = jnp.max(jnp.where(valid, xb, -big), axis=0)
+        return (
+            jnp.min(ax.allgather(mn), axis=0),
+            jnp.max(ax.allgather(mx), axis=0),
+        )
+
+    vmin, vmax = _cached_program(
+        ("sqstats", comms.mesh, comms.axis, Pn, nloc, d, str(x.dtype)),
+        lambda: jax.jit(comms.shard_map(
+            stats_body, in_specs=(sh3, sh1), out_specs=(rep, rep),
+        )),
+    )(x, n_valid)
+    vscale = jnp.maximum(vmax - vmin, 1e-12) / 255.0
+
+    # ---- phase 2c: per-rank int8 encode (elementwise — the sharding of
+    # x carries through; the module-level jit reuses one compiled
+    # program across same-shape rebuilds). The exchange payload is the
+    # int8 pattern viewed as uint8 (modular cast, bit-preserving both
+    # ways), so rows cross the interconnect at one byte per dimension.
+    codes_u8 = _sq_encode_jit(x, vmin, vscale)
+
+    cap = (
+        params.max_list_cap
+        if params.max_list_cap is not None
+        else max(256, 2 * _cdiv_host(n, nl))
+    )
+    maps, slabs = _exchange_and_assemble(
+        comms, x, n_valid, lbl_g, C, cents, cap,
+        store_vectors=False, codes_g=codes_u8, M=d,
+    )
+
+    host = MnmgIVFSQIndex(
+        centroids=maps["cents_np"],
+        owner=maps["owner"],
+        local_id=maps["local_id"],
+        local_cents=maps["lcents_sh"],
+        codes_sorted=jnp.asarray(slabs["codes"]).astype(jnp.int8),
+        vmin=jnp.asarray(vmin, jnp.float32),
+        vscale=jnp.asarray(vscale, jnp.float32),
+        sorted_ids=slabs["sids"],
+        list_offsets=maps["offs_sh"],
+        list_sizes=maps["szs_sh"],
+        n_pad=maps["n_pad"],
+        nl_pad=maps["nl_pad"],
+        max_list=maps["max_list"],
+        n_rows=n,
+    )
+    return place_index(comms, host)
+
+
+@jax.jit
+def _sq_encode_jit(xx, mn, sc):
+    # THE shared encoder (ivf_sq.sq_encode), viewed as uint8 for the
+    # exchange payload (modular cast, bit-preserving both ways)
+    from raft_tpu.spatial.ann.ivf_sq import sq_encode
+
+    return sq_encode(xx, mn, sc).astype(jnp.uint8)
+
+
+def mnmg_ivf_sq_search(
+    comms: Comms, index: MnmgIVFSQIndex, queries, k: int, *,
+    n_probes: int = 8, qcap: typing.Union[int, str, None] = None,
+    list_block: int = 32,
+    qcap_max_drop_frac: typing.Optional[float] = None,
+    donate_queries: bool = False,
+    shard_mask=None,
+    failover=None,
+    overprobe: float = 2.0,
+    merge_ways: typing.Optional[int] = None,
+    mutation=None,
+    wire: str = "bf16",
+    use_pallas: typing.Optional[bool] = None,
+    rerank_ratio: float = 4.0,
+):
+    """Distributed grouped IVF-SQ search over a list-sharded int8 index
+    — the SQ mode of the ONE fused flat-family serving program (the
+    same ``_cached_search`` body as :func:`mnmg_ivf_flat_search`, with
+    the replicated affine pair as two extra runtime operands). Returns
+    (squared L2 distances over the dequantized vectors, GLOBAL row
+    ids), both (nq, k) replicated — the single-chip
+    :func:`~raft_tpu.spatial.ann.ivf_sq.ivf_sq_search_grouped`
+    semantics at mesh width.
+
+    Every serving knob matches the flat engine's and shares its runtime
+    contracts: ``shard_mask``/``failover`` (degraded serving + replica
+    routing as runtime inputs — health and failover flips never
+    recompile, the same zero-retrace audit as the flat engine, with the
+    SQ kernel engaged), ``overprobe``/``merge_ways`` (two-level probe +
+    deployment-width in-program merge), ``mutation`` (per-rank
+    tombstone mask + delta segments), ``wire`` (2-level meshes), and
+    ``use_pallas``/``rerank_ratio`` — auto (``None``) engages the int8
+    in-kernel dequant+scan engine (spatial/ann/sq_kernel) on TPU
+    whenever the shared planner approves the config, scanning each
+    shard's int8 slabs INSIDE the fused one-dispatch program. SQ
+    distances are squared (like the single-chip engine); the shared
+    wrapper :func:`_flat_family_search` holds the one statics/operand
+    authority for both engines."""
+    return _flat_family_search(
+        comms, index, queries, k, sq=True, n_probes=n_probes,
+        qcap=qcap, list_block=list_block,
+        qcap_max_drop_frac=qcap_max_drop_frac,
+        donate_queries=donate_queries, shard_mask=shard_mask,
+        failover=failover, overprobe=overprobe, merge_ways=merge_ways,
+        mutation=mutation, wire=wire, use_pallas=use_pallas,
+        rerank_ratio=rerank_ratio,
     )

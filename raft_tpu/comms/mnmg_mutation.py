@@ -132,10 +132,12 @@ def _place_state(comms: Comms, rm, dv, di, dc, cap) -> MnmgMutationState:
 
 def wrap_mnmg_mutable(comms: Comms, index, *,
                       delta_cap: int = 16) -> MnmgMutableIndex:
-    """Wrap a sharded (PQ or Flat) index for online mutation: empty
+    """Wrap a sharded (PQ, Flat, or SQ) index for online mutation: empty
     per-rank delta slabs of static ``delta_cap`` rows per local list
     plus an all-live row mask, placed onto the mesh with the slab
-    sharding. The index's own arrays are aliased, not copied."""
+    sharding. The index's own arrays are aliased, not copied. Delta rows
+    are stored as exact f32 on every engine (SQ included — a fresh row
+    serves at full precision until a compaction folds it)."""
     errors.expects(delta_cap >= 1, "delta_cap=%d < 1", delta_cap)
     Pn = int(index.sorted_ids.shape[0])
     errors.expects(
@@ -352,10 +354,18 @@ def mnmg_mutable_search(comms: Comms, mindex: MnmgMutableIndex, queries,
     in-program). All other knobs — ``shard_mask``/``failover``,
     ``qcap``, ``merge_ways``, ``use_pallas`` — pass through unchanged."""
     from raft_tpu.comms.mnmg_ivf import MnmgIVFPQIndex, mnmg_ivf_pq_search
-    from raft_tpu.comms.mnmg_ivf_flat import mnmg_ivf_flat_search
+    from raft_tpu.comms.mnmg_ivf_flat import (
+        MnmgIVFSQIndex,
+        mnmg_ivf_flat_search,
+        mnmg_ivf_sq_search,
+    )
 
     if isinstance(mindex.index, MnmgIVFPQIndex):
         return mnmg_ivf_pq_search(
+            comms, mindex.index, queries, k, mutation=mindex.state, **kw
+        )
+    if isinstance(mindex.index, MnmgIVFSQIndex):
+        return mnmg_ivf_sq_search(
             comms, mindex.index, queries, k, mutation=mindex.state, **kw
         )
     return mnmg_ivf_flat_search(

@@ -24,6 +24,7 @@ from raft_tpu.spatial.ann.ivf_sq import (
     IVFSQIndex,
     ivf_sq_build,
     ivf_sq_search,
+    ivf_sq_search_grouped,
 )
 from raft_tpu.spatial.ann.approx import (
     approx_knn_build_index, approx_knn_search,
@@ -59,6 +60,7 @@ __all__ = [
     "IVFPQParams", "IVFPQIndex", "ivf_pq_build", "ivf_pq_search",
     "ivf_pq_search_grouped",
     "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
+    "ivf_sq_search_grouped",
     "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",
     "save_index", "load_index",
     "approx_knn_build_index", "approx_knn_search",

@@ -25,7 +25,7 @@ __all__ = [
     "CoarseIndex", "ListStorage", "build_coarse_index",
     "build_list_storage", "coarse_probe_recall", "default_coarse_geometry",
     "n_super_probes", "probe_flop_accounting", "split_oversized_lists",
-    "static_qcap", "two_level_probe",
+    "static_qcap", "two_level_probe", "two_level_probe_kernel_supported",
 ]
 
 
@@ -183,7 +183,9 @@ def build_coarse_index(centroids, *, n_super=None, member_cap=None,
 
 def two_level_probe(qf, super_cents, member_ids, cents_padded,
                     n_cents: int, n_probes: int, n_sup_probes: int,
-                    block_q: int = 256, precision=None):
+                    block_q: int = 256, precision=None,
+                    use_pallas: bool = False,
+                    pallas_interpret: bool = False):
     """Sub-linear coarse probe: score queries against the super-centroid
     set, gather the top ``n_sup_probes`` super clusters' member blocks,
     and exactly rerank only those candidate centroids. Returns
@@ -198,11 +200,47 @@ def two_level_probe(qf, super_cents, member_ids, cents_padded,
     so the (block, S·max_members, d) candidate gather stays HBM-bounded.
     When ``n_sup_probes`` covers every super cluster the probe reranks
     every centroid — exactly the flat scan's candidate set.
+
+    ``use_pallas=True`` (ISSUE 11) routes BOTH probe stages through the
+    shared scan-kernel core (:mod:`raft_tpu.spatial.ann.scan_core`) so
+    neither wide distance tile materializes in HBM inside a fused
+    serving program: the super scan runs as a one-slab sub-chunk-min
+    kernel (only (block, n_super/8) minima leave VMEM, the covered 8-row
+    granules reranked in exact f32), and the member stage runs the ONE
+    grouped scan body (``ivf_flat._grouped_impl``) over a mini flat
+    index whose "lists" are the super clusters and whose slab is the
+    padded member-centroid block — the same kernel, planner, masking,
+    and exact rerank tail as the engines themselves. Falls back to this
+    legacy path when :func:`two_level_probe_kernel_supported` says the
+    geometry does not fit (the fused bodies pass their own ``use_pallas``
+    static through, so probe engine choice can never flip at runtime).
+    Results match the legacy probe's selected lists exactly whenever the
+    mini grouped body's probe qcap drops no (query, super) pairs (a
+    4x-mean shape-only cap, double the engines' default — the probe has
+    no per-call audit, so it buys margin statically; slots fill in
+    probe-RANK order, so a hot super that still overflows drops each
+    query's marginal last-rank pairs first, never its top picks). On a
+    query-skewed workload audit the kernelized probe with
+    :func:`coarse_probe_recall(..., use_pallas=True)` before enabling
+    it — or pin ``use_pallas=False`` on the probe-carrying search.
     """
     f32 = jnp.float32
     qf = jnp.asarray(qf).astype(f32)
     ns, mm, d = cents_padded.shape
     S = max(1, min(int(n_sup_probes), ns))
+    # the kernel path serves the DEFAULT-precision probe only: a caller
+    # pinning `precision` (the ball-cover exactness discipline) asked
+    # for that exact matmul mode, which the bf16 scan stage cannot
+    # honor — fall through to the legacy path instead of silently
+    # ignoring the pin
+    if use_pallas and precision is None and \
+            two_level_probe_kernel_supported(
+                d, qf.shape[0], n_probes, ns, mm, S, block_q
+            ):
+        return _two_level_probe_kernel(
+            qf, super_cents, member_ids, cents_padded, n_cents,
+            n_probes, S, block_q, pallas_interpret,
+        )
 
     def blk(qb):
         bq = qb.shape[0]
@@ -229,14 +267,179 @@ def two_level_probe(qf, super_cents, member_ids, cents_padded,
     return probes, vals
 
 
+def _probe_qcap(nq: int, n_sup_probes: int, n_super: int) -> int:
+    """The mini grouped body's queries-per-super cap for the kernelized
+    two-level probe: 4x the mean per-super occupancy (DOUBLE the
+    engines' 2x-mean default — the probe has no per-call resolve_qcap
+    audit, so it buys margin with shape math instead), 8-aligned,
+    clamped to nq. Shape-only, so the fused programs stay free of host
+    syncs and the cap is a trace-time static. Slots fill in probe-RANK
+    order (invert_probe_map_ranked), so when a hot super still
+    overflows — every query crowding the same few supers — each query
+    KEEPS the supers it ranked highest and loses marginal last-rank
+    pairs first; audit a skewed workload with
+    :func:`coarse_probe_recall(..., use_pallas=True)` before enabling
+    the kernelized probe on it."""
+    return min(nq, 2 * default_qcap(nq, n_sup_probes, n_super))
+
+
+def two_level_probe_kernel_supported(d: int, nq: int, n_probes: int,
+                                     n_super: int, max_members: int,
+                                     n_sup_probes: int,
+                                     block_q: int = 256) -> bool:
+    """Whether the kernelized two-level probe applies at this geometry
+    (all static ints — evaluable at trace time inside a fused body):
+    both stages' (query block, tile) steps must fit the shared planner's
+    VMEM budget (``flat_scan_supported`` — the probe reuses the flat
+    engine's byte model), and the reranked member pool must be able to
+    fill a top-``n_probes`` row. When False, ``use_pallas=True`` on
+    :func:`two_level_probe` silently serves the legacy path — the probe
+    is an internal stage, and the engines' own ``use_pallas=True``
+    contract (raise on unsupported) applies to the scan they were asked
+    to kernelize, not to this auxiliary geometry."""
+    if d < 1 or n_super < 1 or max_members < 1:
+        return False
+    from raft_tpu.spatial.ann.flat_kernel import flat_scan_supported
+
+    s1_block = min(block_q, max(nq, 1))
+    return (
+        n_probes <= n_sup_probes * max_members
+        and flat_scan_supported(d, s1_block)
+        and flat_scan_supported(
+            d, _probe_qcap(nq, n_sup_probes, n_super)
+        )
+    )
+
+
+def _two_level_probe_kernel(qf, super_cents, member_ids, cents_padded,
+                            n_cents: int, n_probes: int, S: int,
+                            block_q: int, interpret: bool):
+    """The ``use_pallas`` body of :func:`two_level_probe` — both stages
+    through the shared scan-kernel core (module docstring of
+    ``scan_core``; the caller has already validated
+    :func:`two_level_probe_kernel_supported`)."""
+    from raft_tpu.spatial.ann import flat_kernel, scan_core
+    from raft_tpu.spatial.ann.ivf_flat import _grouped_impl
+
+    f32 = jnp.float32
+    nq = qf.shape[0]
+    ns, mm, d = cents_padded.shape
+    sub = scan_core.SUBCHUNK
+    sup_f = jnp.asarray(super_cents, f32)
+
+    # ---- stage 1: the super scan as a one-slab sub-chunk-min kernel.
+    # The (block, n_super) distance tile never materializes: the kernel
+    # emits (block, ns_pad/8) minima, the top-c granules' 8 rows are
+    # reranked in exact f32 (HIGHEST), and the top-S supers come from
+    # that rerank — the engines' own two-phase recipe applied to the
+    # probe itself. c = 2S margin: the bf16 scan only perturbs granule
+    # ranking near the boundary (the cover argument at 8-row grain).
+    s1_block = min(block_q, max(nq, 1))
+    q_kpad1 = scan_core.pad_queries(s1_block)
+    # capped at the super set's own lane-rounded height (the small-slab
+    # rule — see ivf_flat._grouped_impl), under the profile the block
+    # size selects (the qcap-1/8 latency dispatches get the wide tile
+    # in the probe stage too)
+    l_tile1 = flat_kernel.plan_l_tile(
+        d, q_kpad1, l_tile=-(-ns // scan_core.LANE) * scan_core.LANE,
+        profile=scan_core.tile_profile(s1_block),
+    )
+    ns_pad = -(-ns // l_tile1) * l_tile1
+    sup_t = jnp.pad(
+        sup_f.T, ((0, 0), (0, ns_pad - ns))
+    )[None]                                       # (1, d, ns_pad)
+    s1_bounds = jnp.asarray([[0, ns]], jnp.int32)
+    width1 = ns_pad // sub
+    c1 = min(width1, 2 * S)
+
+    def super_blk(qb):
+        bq = qb.shape[0]
+        qv = qb if bq == q_kpad1 else jnp.pad(
+            qb, ((0, q_kpad1 - bq), (0, 0))
+        )
+        mins = flat_kernel.flat_scan_subchunk_min(
+            qv[None], sup_t, s1_bounds,
+            interpret=interpret, l_tile=l_tile1,
+        )[0, :bq]                                 # (bq, width1)
+        nv, cpos = jax.lax.top_k(-mins, c1)
+        rows = (
+            cpos[:, :, None] * sub
+            + jnp.arange(sub, dtype=jnp.int32)[None, None, :]
+        ).reshape(bq, c1 * sub)                   # candidate super rows
+        valid = (
+            (rows < ns)
+            & (jnp.isfinite(-nv) & (-nv < scan_core.BIG))[
+                :, :, None
+            ].repeat(sub, axis=2).reshape(bq, c1 * sub)
+        )
+        cand = sup_f[jnp.clip(rows, 0, ns - 1)]   # (bq, c1*8, d)
+        exact = score_l2_candidates(qb, cand, valid)
+        sv, spos = jax.lax.top_k(-exact, S)
+        sup_sel = jnp.take_along_axis(rows, spos, axis=1)
+        return -sv, jnp.minimum(sup_sel, ns - 1).astype(jnp.int32)
+
+    _, sup = map_query_blocks(super_blk, qf, s1_block)   # (nq, S)
+
+    # ---- stage 2: the member gather + exact rerank as the ONE grouped
+    # scan body over a mini flat index — "lists" are super clusters,
+    # the slab is the flattened padded member block (build_coarse_index
+    # packs each super's valid members first, so [s*mm, s*mm + size_s)
+    # is exactly list s's valid range), sorted_ids map slab positions
+    # back to centroid ids. The member-block distance tile lives only in
+    # VMEM; the rerank tail's exact f32 distances are the returned probe
+    # distances (squared, like the legacy probe's).
+    from raft_tpu.spatial.ann.ivf_flat import IVFFlatIndex
+
+    sizes = jnp.sum(member_ids < n_cents, axis=1).astype(jnp.int32)
+    offsets = (jnp.arange(ns + 1, dtype=jnp.int32) * mm)
+    # one pad op appends the sentinel row the grouped body's shape
+    # contract needs (the reshape itself is a view). This is a fixed
+    # ~ns*mm*d*4-byte per-dispatch copy; carrying the sentinel inside
+    # CoarseIndex would remove it at the cost of a serialization-format
+    # change — revisit if the probe stage shows up in latency traces.
+    data_sorted = jnp.pad(
+        cents_padded.reshape(ns * mm, d).astype(f32), ((0, 1), (0, 0))
+    )
+    storage = ListStorage(
+        sorted_ids=member_ids.reshape(ns * mm).astype(jnp.int32),
+        list_offsets=offsets,
+        # only the leading axis is read on the grouped path (it carries
+        # the list count)
+        list_index=jnp.zeros((ns, 1), jnp.int32),
+        list_sizes=sizes,
+        n=ns * mm,
+        max_list=mm,
+    )
+    mini = IVFFlatIndex(
+        centroids=sup_f, data_sorted=data_sorted, storage=storage,
+        metric="sqeuclidean",
+    )
+    d2, probes = _grouped_impl(
+        mini, qf, n_probes, S, _probe_qcap(nq, S, ns),
+        max(1, min(8, ns)), probes=sup,
+        use_pallas=True, pallas_interpret=interpret, rerank_ratio=2.0,
+    )
+    # the legacy probe's sentinel clamp: a +inf slot (fewer than
+    # n_probes valid candidates) maps to id 0 so downstream
+    # owner[probe] gathers stay in range
+    probes = jnp.where(jnp.isfinite(d2), probes, 0)
+    return probes.astype(jnp.int32), d2
+
+
 def coarse_probe_recall(queries, centroids, coarse: "CoarseIndex",
                         n_probes: int, *, overprobe: float = 2.0,
-                        block_q: int = 256) -> float:
+                        block_q: int = 256,
+                        use_pallas: bool = False) -> float:
     """The two-level probe's recall guardrail: fraction of the flat
     scan's probed lists the two-level probe reproduces on ``queries``
     (eager, host sync — an audit, not a serving-path call). Bench
     workloads must stay within 0.01 of the flat probe; sweep
-    ``overprobe`` up when they don't."""
+    ``overprobe`` up when they don't. ``use_pallas=True`` audits the
+    KERNELIZED probe instead (interpret mode off-TPU) — run it on a
+    representative batch before enabling the kernel probe on a
+    query-skewed workload, where the probe's shape-only qcap can drop
+    marginal (query, super) pairs the legacy path keeps
+    (``_probe_qcap``)."""
     qf = jnp.asarray(queries, jnp.float32)
     flat, _ = coarse_probe(qf, jnp.asarray(centroids, jnp.float32),
                            n_probes)
@@ -244,6 +447,8 @@ def coarse_probe_recall(queries, centroids, coarse: "CoarseIndex",
     two, _ = two_level_probe(
         qf, coarse.super_cents, coarse.member_ids, coarse.cents_padded,
         coarse.n_cents, n_probes, S, block_q,
+        use_pallas=use_pallas,
+        pallas_interpret=jax.default_backend() != "tpu",
     )
     a, b = np.asarray(flat), np.asarray(two)
     hits = sum(

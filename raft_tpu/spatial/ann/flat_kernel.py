@@ -1,7 +1,12 @@
 """Pallas scan engine for IVF-Flat — the exact-scoring port of the
 ``fused_knn``/``pq_kernel`` two-phase recipe (ISSUE 10; reference: the
 fused distance+select kernel the CUDA side uses for exactly this shape
-of cost, detail/fused_l2_knn.cuh, SURVEY §12/§17).
+of cost, detail/fused_l2_knn.cuh, SURVEY §12/§17). Since ISSUE 11 the
+engine is a thin instantiation of the shared scan-kernel core
+(:mod:`raft_tpu.spatial.ann.scan_core`): the tile planner, the [lo, hi)
+slab masking, the 8-row sub-chunk-min select, and the lax-mirror
+discipline live there once; this module contributes only the flat
+distance computation (bf16 gram + f32 norm terms).
 
 Why a kernel: the XLA grouped-flat path (``ivf_flat._grouped_impl``)
 materializes a full ``(LB, qcap, L)`` f32 distance tile in HBM per list
@@ -53,32 +58,21 @@ callers reach it only when they explicitly opt in with
 from __future__ import annotations
 
 import functools
+import typing
 
-import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.spatial.ann import scan_core
+from raft_tpu.spatial.ann.scan_core import (
+    BIG as BIG,  # re-export: callers read the masked-row constant here
+    SUBCHUNK,
+    pad_queries,
+)
 
 __all__ = [
     "SUBCHUNK", "pad_queries", "plan_l_tile", "flat_scan_subchunk_min",
     "flat_scan_subchunk_min_lax", "flat_scan_supported",
 ]
-
-SUBCHUNK = 8      # rows per selection granule (f32 sublane width)
-_LANE = 128       # slab-tile rows must be lane-aligned
-_Q_GRANULE = 16   # bf16 sublane tile: the query axis pads to this
-
-# Masked rows score a finite BIG (never +inf: inf - inf NaNs on the VPU,
-# and pooled selection must still order masked sub-chunks last).
-BIG = 1e30
-
-# VMEM working-set budget for one grid step (slab tile + query block +
-# distance tile), double-buffering headroom included. ~16 MB/core total.
-_VMEM_BUDGET = 10 * 2**20
-
-
-def _round_up(a: int, b: int) -> int:
-    return -(-a // b) * b
 
 
 def _step_bytes(d: int, q_pad: int, l_tile: int) -> int:
@@ -88,63 +82,34 @@ def _step_bytes(d: int, q_pad: int, l_tile: int) -> int:
     return 2 * 2 * d * l_tile + 2 * 2 * q_pad * d + 4 * q_pad * l_tile
 
 
-def plan_l_tile(d: int, q_pad: int, l_tile: int = 512):
-    """Largest slab-tile width (a multiple of 128, <= ``l_tile``) whose
-    per-step working set fits the VMEM budget; None when even a 128-row
-    tile does not fit (an extreme qcap x d — the caller falls back to
-    the XLA scan)."""
-    lt = max(_LANE, _round_up(min(l_tile, 512), _LANE))
-    while lt > _LANE and _step_bytes(d, q_pad, lt) > _VMEM_BUDGET:
-        # halve, re-aligned down to the lane width (a non-128-multiple
-        # start like 384 must not yield an unusable 192-row tile)
-        lt = max(_LANE, (lt // 2) // _LANE * _LANE)
-    if _step_bytes(d, q_pad, lt) > _VMEM_BUDGET:
-        return None
-    return lt
-
-
-def pad_queries(qcap: int) -> int:
-    """Round a query-slot count up to the kernel's bf16 sublane granule
-    — THE q_pad. :func:`flat_scan_supported` and the grouped serving
-    path (``ivf_flat._grouped_impl``) both call this, so the resolver's
-    approval and the serving plan can never round differently."""
-    return _round_up(max(qcap, 1), _Q_GRANULE)
+def plan_l_tile(d: int, q_pad: int,
+                l_tile: typing.Optional[int] = None,
+                profile: str = "throughput"):
+    """The flat engine's byte model handed to the ONE shared planner
+    (:func:`raft_tpu.spatial.ann.scan_core.plan_l_tile`): largest
+    lane-aligned slab-tile width whose per-step working set fits the
+    VMEM budget, from the profile's start width (512 throughput / 1024
+    latency); None when even a 128-row tile does not fit (an extreme
+    qcap x d — the caller falls back to the XLA scan)."""
+    return scan_core.plan_l_tile(
+        functools.partial(_step_bytes, d), q_pad, l_tile, profile
+    )
 
 
 def flat_scan_supported(d: int, qcap: int) -> bool:
     """Whether the Pallas flat-scan engine applies at this config: one
-    (query block, slab tile) step fits the VMEM plan. d is small for
-    every ANN workload, so this only fails at extreme qcap."""
+    (query block, slab tile) step fits the VMEM plan under the profile
+    the grouped path would auto-select for this qcap
+    (``scan_core.tile_profile`` — the plan only ever SHRINKS from the
+    profile start, so supportedness is profile-independent in truth
+    value, and sharing the call keeps the resolver and the serving plan
+    on one code path). d is small for every ANN workload, so this only
+    fails at extreme qcap."""
     if d < 1:
         return False
-    return plan_l_tile(d, pad_queries(qcap)) is not None
-
-
-def _scan_kernel(bounds_ref, q_ref, slab_ref, o_ref, *, l_tile: int,
-                 sub: int):
-    """One (list b, slab-tile t) grid step: MXU gram against the
-    VMEM-resident query block, f32 norm terms on the VPU, slab-range
-    masking, sub-chunk min — nothing but the (Q, Lt/sub) minima is
-    written out."""
-    b = pl.program_id(0)
-    t = pl.program_id(1)
-    qv = q_ref[0]                             # (Qp, d)  bf16
-    y = slab_ref[0]                           # (d, Lt)  bf16
-    dots = jax.lax.dot_general(
-        qv, y, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                         # (Qp, Lt) f32
-    qf = qv.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1, keepdims=True)       # (Qp, 1)
-    yf = y.astype(jnp.float32)
-    yn = jnp.sum(yf * yf, axis=0, keepdims=True)       # (1, Lt)
-    d2 = qn + yn - 2.0 * dots
-    lo = bounds_ref[b, 0]
-    hi = bounds_ref[b, 1]
-    col = t * l_tile + jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
-    d2 = jnp.where((col >= lo) & (col < hi), d2, jnp.float32(BIG))
-    q_pad = d2.shape[0]
-    o_ref[0] = jnp.min(d2.reshape(q_pad, l_tile // sub, sub), axis=2)
+    return plan_l_tile(
+        d, pad_queries(qcap), profile=scan_core.tile_profile(qcap)
+    ) is not None
 
 
 def flat_scan_subchunk_min(qrows, slabs_t, bounds, *, interpret: bool,
@@ -159,62 +124,33 @@ def flat_scan_subchunk_min(qrows, slabs_t, bounds, *, interpret: bool,
     (itself a multiple of 128) — the caller pads; padded query rows
     produce garbage-but-finite minima the caller drops."""
     lb, q_pad, d = qrows.shape
-    d_s, l_pad = slabs_t.shape[1], slabs_t.shape[2]
+    d_s = slabs_t.shape[1]
     if d_s != d:
         raise ValueError(
             f"flat_scan_subchunk_min: query dim {d} != slab dim {d_s}"
         )
-    if q_pad % _Q_GRANULE or l_pad % l_tile or l_tile % _LANE:
-        raise ValueError(
-            f"flat_scan_subchunk_min: Q={q_pad} must be a multiple of "
-            f"{_Q_GRANULE} and Lpad={l_pad} a multiple of "
-            f"l_tile={l_tile} (itself a multiple of {_LANE})"
-        )
-    kernel = functools.partial(_scan_kernel, l_tile=l_tile, sub=SUBCHUNK)
-    nsc_t = l_tile // SUBCHUNK
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(lb, l_pad // l_tile),
-            in_specs=[
-                pl.BlockSpec((1, q_pad, d), lambda b, t, bnd: (b, 0, 0)),
-                pl.BlockSpec((1, d, l_tile), lambda b, t, bnd: (b, 0, t)),
-            ],
-            out_specs=pl.BlockSpec((1, q_pad, nsc_t),
-                                   lambda b, t, bnd: (b, 0, t)),
-        ),
-        out_shape=jax.ShapeDtypeStruct(
-            (lb, q_pad, l_pad // SUBCHUNK), jnp.float32
-        ),
-        interpret=interpret,
-    )(bounds.astype(jnp.int32), qrows.astype(jnp.bfloat16),
-      slabs_t.astype(jnp.bfloat16))
-    return out
+
+    def tile_fn(res, til, bc):
+        # (Qp, d) bf16 query block x (d, Lt) bf16 slab tile -> the
+        # shared flat-family distance body
+        return scan_core.l2_gram_tile(res[0], til[0])
+
+    return scan_core.subchunk_scan(
+        tile_fn, bounds,
+        [qrows.astype(jnp.bfloat16)], [slabs_t.astype(jnp.bfloat16)],
+        l_tile=l_tile, interpret=interpret,
+        name="flat_scan_subchunk_min",
+    )
 
 
 def flat_scan_subchunk_min_lax(qrows, slabs_t, bounds):
     """Op-for-op XLA mirror of :func:`flat_scan_subchunk_min` (same bf16
     contraction with f32 accumulation, same f32 norm terms, same masking
-    and sub-chunk reduce) — the bit-compat reference the tier-1 tests
-    pin the interpret-mode kernel against, and the engine's fallback
-    wherever ``pallas_call`` is unavailable."""
-    lb, q_pad, d = qrows.shape
-    l_pad = slabs_t.shape[2]
-    qb = qrows.astype(jnp.bfloat16)
-    yb = slabs_t.astype(jnp.bfloat16)
-    dots = jax.lax.dot_general(
-        qb, yb, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
+    and sub-chunk reduce via ``scan_core.mask_subchunk_min_lax``) — the
+    bit-compat reference the tier-1 tests pin the interpret-mode kernel
+    against, and the engine's fallback wherever ``pallas_call`` is
+    unavailable."""
+    d2 = scan_core.l2_gram_tile(
+        qrows.astype(jnp.bfloat16), slabs_t.astype(jnp.bfloat16)
     )                                                  # (LB, Qp, Lp) f32
-    qf = qb.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=2)                      # (LB, Qp)
-    yf = yb.astype(jnp.float32)
-    yn = jnp.sum(yf * yf, axis=1)                      # (LB, Lp)
-    d2 = qn[:, :, None] + yn[:, None, :] - 2.0 * dots
-    col = jnp.arange(l_pad, dtype=jnp.int32)[None, None, :]
-    lo = bounds[:, 0][:, None, None]
-    hi = bounds[:, 1][:, None, None]
-    d2 = jnp.where((col >= lo) & (col < hi), d2, jnp.float32(BIG))
-    return jnp.min(d2.reshape(lb, q_pad, l_pad // SUBCHUNK, SUBCHUNK),
-                   axis=3)
+    return scan_core.mask_subchunk_min_lax(d2, bounds)

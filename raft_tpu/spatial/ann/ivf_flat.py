@@ -204,7 +204,8 @@ _RERANK_BLOCK_BYTES = 256 << 20
 )
 def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
                   stream_partials=None, row_mask=None, use_pallas=False,
-                  pallas_interpret=False, rerank_ratio=4.0):
+                  pallas_interpret=False, rerank_ratio=4.0,
+                  dequant=None):
     # ``row_mask``: optional (n + 1,) RUNTIME live mask over slab
     # positions (the tombstone-deletion input of the mutation tier,
     # raft_tpu/spatial/ann/mutation.py — the shard_mask trick applied to
@@ -213,6 +214,16 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
     # path it is applied per ROW at the exact rerank tail (the in-kernel
     # sub-chunk minima are unmasked — a dead row can crowd a pool slot,
     # never surface; the PQ precedent, docs/mutation.md).
+    #
+    # ``dequant``: optional ``(vmin, vscale)`` (d,) runtime pair — the
+    # IVF-SQ mode of the ONE grouped scan body (ISSUE 11):
+    # ``index.data_sorted`` then holds int8 QT_8bit codes and every row
+    # the scan or the rerank tail touches is mapped through
+    # ``y = (code + 128) · vscale + vmin`` first. The XLA path
+    # dequantizes the gathered slab block (the lax fallback — it pays
+    # the f32 expansion in HBM); the kernel path routes through the
+    # int8 in-kernel engine (spatial/ann/sq_kernel), where the slab
+    # crosses HBM at one byte per element and expands only in VMEM.
     storage = index.storage
     n_lists = storage.list_index.shape[0]
     L = storage.max_list
@@ -220,6 +231,16 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
     p = n_probes
     f32 = jnp.float32
     qf = q.astype(f32)
+
+    def dq_rows(rows_f32):
+        """Affine-dequantize gathered/sliced rows when the scan runs in
+        SQ mode (no-op for the flat engine) — the XLA/rerank side runs
+        through THE shared decoder (ivf_sq.sq_decode)."""
+        if dequant is None:
+            return rows_f32
+        from raft_tpu.spatial.ann.ivf_sq import sq_decode
+
+        return sq_decode(rows_f32, dequant[0], dequant[1])
 
     from raft_tpu.spatial.ann.common import (
         coarse_probe, invert_probe_map_ranked,
@@ -248,9 +269,9 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
         offs = storage.list_offsets[lblk]                    # (LB,)
         szs = storage.list_sizes[lblk]
         o_c = jnp.minimum(offs, storage.n + 1 - L)           # slice clamp
-        mv = jax.vmap(
+        mv = dq_rows(jax.vmap(
             lambda s: lax.dynamic_slice(index.data_sorted, (s, 0), (L, d))
-        )(o_c).astype(f32)                                   # (LB, L, d)
+        )(o_c).astype(f32))                                  # (LB, L, d)
         pos = o_c[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
         in_list = (pos >= offs[:, None]) & (pos < (offs + szs)[:, None])
         if row_mask is not None:
@@ -265,7 +286,11 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
         d2 = qnv[:, :, None] + mn[:, None, :] - 2.0 * dots
         invalid = (qids >= nq)[:, :, None] | (~in_list)[:, None, :]
         d2 = jnp.where(invalid, jnp.inf, d2)
-        vals, sel = lax.top_k(-d2, k)                        # (LB, qcap, k)
+        # the INTENTIONAL legacy materialized-tile scan, kept as the
+        # use_pallas=False bit-stable engine and the CPU fallback — the
+        # Pallas sub-chunk-min path above it is the fixed spelling
+        # (docs/static_analysis.md "Baseline burn-down"):
+        vals, sel = lax.top_k(-d2, k)  # jaxlint: disable=wide-distance-materialize
         # k-wide selection remap, not a LUT gather:
         memp = jnp.take_along_axis(  # jaxlint: disable=adc-gather
             jnp.broadcast_to(pos[:, None, :], d2.shape), sel, axis=2
@@ -274,13 +299,33 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
 
     use_kernel = bool(use_pallas)
     if use_kernel:
-        from raft_tpu.spatial.ann import flat_kernel
+        from raft_tpu.spatial.ann import scan_core
 
-        sub = flat_kernel.SUBCHUNK
-        # the SAME rounding flat_scan_supported validated the VMEM plan
-        # with, so the resolver's approval and this plan cannot drift
-        q_kpad = flat_kernel.pad_queries(qcap)
-        l_tile = flat_kernel.plan_l_tile(d, q_kpad)
+        if dequant is None:
+            from raft_tpu.spatial.ann import flat_kernel as kmod
+        else:
+            # the SQ mode of the one grouped body: int8 slabs DMA'd to
+            # VMEM at one byte per element, dequantized there (the
+            # sq_kernel module docstring carries the full argument)
+            from raft_tpu.spatial.ann import sq_kernel as kmod
+
+        sub = scan_core.SUBCHUNK
+        # the SAME rounding + profile the engine's *_supported predicate
+        # validated the VMEM plan with, so the resolver's approval and
+        # this plan cannot drift. tile_profile(qcap) auto-selects the
+        # latency plan (1024-row start) for the qcap-1/8 open-loop
+        # serving shapes — the p99 regime stops paying throughput-shape
+        # tiles (docs/ivf_scale.md "One scan-kernel core").
+        q_kpad = scan_core.pad_queries(qcap)
+        # cap the plan at the list slab's own (lane-rounded) height: a
+        # wide profile start must never widen the per-list window past
+        # max_list — that would double slab DMA + masked-garbage compute
+        # on small-list indexes in exactly the latency regime the wide
+        # start targets
+        l_tile = kmod.plan_l_tile(
+            d, q_kpad, l_tile=-(-L // scan_core.LANE) * scan_core.LANE,
+            profile=scan_core.tile_profile(qcap),
+        )
         l_pad = -(-L // l_tile) * l_tile
         nsc = l_pad // sub
         rows = index.data_sorted.shape[0]     # n + 1 (sentinel row)
@@ -307,10 +352,18 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
             )(o_c).transpose(0, 2, 1)                        # (LB, d, l_pad)
             lo = offs - o_c
             bounds = jnp.stack([lo, lo + szs], axis=1)       # (LB, 2)
-            mins = flat_kernel.flat_scan_subchunk_min(
-                qv, slabs_t, bounds,
-                interpret=pallas_interpret, l_tile=l_tile,
-            )[:, :qcap]                                      # (LB, qcap, nsc)
+            if dequant is None:
+                mins = kmod.flat_scan_subchunk_min(
+                    qv, slabs_t, bounds,
+                    interpret=pallas_interpret, l_tile=l_tile,
+                )
+            else:
+                mins = kmod.sq_scan_subchunk_min(
+                    qv, slabs_t.astype(jnp.int8), bounds,
+                    dequant[0], dequant[1],
+                    interpret=pallas_interpret, l_tile=l_tile,
+                )
+            mins = mins[:, :qcap]                            # (LB, qcap, nsc)
             # positions are NOT returned: a sub-chunk's slab base is
             # fully derivable from (probe slot, chunk index) after
             # selection, so the kernel path pools VALUES ONLY — half
@@ -426,7 +479,7 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
             (rows_sel >= off_sel[:, :, None])
             & (rows_sel < end_sel[:, :, None])
             & (jnp.isfinite(nadc)
-               & (nadc < flat_kernel.BIG))[:, :, None]
+               & (nadc < scan_core.BIG))[:, :, None]
         )
         if row_mask is not None:
             # tombstones are applied per ROW at the rerank tail on the
@@ -439,7 +492,9 @@ def _grouped_impl(index, q, k, n_probes, qcap, list_block, probes=None,
 
         def rerank_blk(args):
             qb, rp, vl = args
-            raw = data_src[jnp.clip(rp, 0, storage.n)].astype(f32)
+            raw = dq_rows(
+                data_src[jnp.clip(rp, 0, storage.n)].astype(f32)
+            )
             exact = score_l2_candidates(qb, raw, vl & (rp < storage.n))
             return select_candidates(storage, rp, exact, k)
 

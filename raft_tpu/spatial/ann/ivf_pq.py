@@ -664,11 +664,22 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
         return vals, memp
 
     if use_kernel:
-        from raft_tpu.spatial.ann import pq_kernel
+        from raft_tpu.spatial.ann import pq_kernel, scan_core
 
         sub = pq_kernel.SUBCHUNK
-        q_kpad = -(-qcap // 16) * 16          # bf16 sublane granule
-        l_tile = pq_kernel.plan_l_tile(M * K, q_kpad)
+        # the shared rounding + profile pq_adc_supported validated the
+        # VMEM plan with (tile_profile auto-selects the latency plan for
+        # qcap-1/8 serving shapes — docs/ivf_scale.md "One scan-kernel
+        # core")
+        q_kpad = scan_core.pad_queries(qcap)
+        # capped at the code slab's own lane-rounded height (see the
+        # flat twin: a wide profile start must not widen the per-list
+        # window past max_list)
+        l_tile = pq_kernel.plan_l_tile(
+            M * K, q_kpad,
+            l_tile=-(-L // scan_core.LANE) * scan_core.LANE,
+            profile=scan_core.tile_profile(qcap),
+        )
         l_pad = -(-L // l_tile) * l_tile
         nsc = l_pad // sub
         rows = index.codes_sorted.shape[0]    # n + 1 (sentinel row)

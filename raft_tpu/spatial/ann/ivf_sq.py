@@ -4,7 +4,14 @@ GpuIndexIVFScalarQuantizer wrap (ann_quantized_faiss.cuh:143-160
 
 Vectors are affinely mapped to int8 per dimension (global min/max train
 pass, the QT_8bit scheme); lists and search reuse the IVF-Flat machinery
-with dequantization fused into the candidate scoring.
+with dequantization fused into the candidate scoring. Since ISSUE 11 the
+grouped (list-major) search runs through the ONE grouped scan body
+(:func:`raft_tpu.spatial.ann.ivf_flat._grouped_impl` in SQ mode) and its
+``use_pallas`` path through the int8 in-kernel dequant+scan engine
+(:mod:`raft_tpu.spatial.ann.sq_kernel`): int8 slab tiles cross HBM at one
+byte per element — HALF the bf16 flat engine's slab traffic — and expand
+to bf16 only in VMEM, with the exact-f32 rerank tail dequantizing through
+the same affine map.
 """
 
 from __future__ import annotations
@@ -27,7 +34,41 @@ from raft_tpu.spatial.ann.common import (
     split_oversized_lists,
 )
 
-__all__ = ["IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search"]
+__all__ = [
+    "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
+    "ivf_sq_search_grouped", "sq_decode", "sq_encode",
+]
+
+
+def sq_encode(x, vmin, vscale):
+    """THE QT_8bit affine encoder — ``clip(round((x - vmin) / vscale)
+    - 128)`` as int8, per dimension over the LAST axis (any leading
+    shape). The one spelling shared by the single-chip build, the
+    distributed build's per-rank encode, and compaction's re-encode;
+    its inverse is :func:`sq_decode` (and, column-wise in-kernel,
+    ``sq_kernel._dequant_tile``) — the pair must never drift."""
+    x = jnp.asarray(x)
+    shape = (1,) * (x.ndim - 1) + (-1,)
+    vmin = jnp.asarray(vmin, jnp.float32).reshape(shape)
+    vscale = jnp.asarray(vscale, jnp.float32).reshape(shape)
+    return jnp.clip(
+        jnp.round((x.astype(jnp.float32) - vmin) / vscale) - 128,
+        -128, 127,
+    ).astype(jnp.int8)
+
+
+def sq_decode(codes_f32, vmin, vscale):
+    """THE QT_8bit affine decoder — ``y = (code + 128)·vscale + vmin``
+    in f32, per dimension over the LAST axis. ``codes_f32``: codes
+    already widened to f32 (callers widen once at their gather/slice).
+    Shared by the grouped body's XLA scan + rerank tail, the per-query
+    search, and compaction; the in-kernel column-layout spelling with
+    the single bf16 round is ``sq_kernel._dequant_tile``."""
+    shape = (1,) * (codes_f32.ndim - 1) + (-1,)
+    return (
+        (codes_f32 + 128.0) * jnp.reshape(vscale, shape)
+        + jnp.reshape(vmin, shape)
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,6 +89,31 @@ class IVFSQIndex:
     vscale: jax.Array         # (d,)
     storage: ListStorage
 
+    def warmup(self, nq: int, *, k: int = 10, n_probes: int = 8,
+               qcap=None, list_block: int = 32,
+               stream_partials=None,
+               use_pallas: typing.Optional[bool] = None,
+               rerank_ratio: float = 4.0) -> int:
+        """Pre-compile the grouped SQ serving program for (nq, d) float32
+        batches — the SQ sibling of :meth:`IVFFlatIndex.warmup`: one
+        all-zeros batch is dispatched through
+        :func:`ivf_sq_search_grouped` and blocked on, so the first real
+        batch pays dispatch, not trace+compile. ``qcap`` resolves
+        SHAPE-ONLY (:func:`...ann.common.static_qcap`) and the resolved
+        value is returned; pass exactly that integer on every serving
+        dispatch (docs/serving.md)."""
+        from raft_tpu.spatial.ann.common import static_qcap
+
+        qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
+        q0 = jnp.zeros((nq, self.centroids.shape[1]), jnp.float32)
+        out = ivf_sq_search_grouped(
+            self, q0, k, n_probes=n_probes, qcap=qc,
+            list_block=list_block, stream_partials=stream_partials,
+            use_pallas=use_pallas, rerank_ratio=rerank_ratio,
+        )
+        jax.block_until_ready(out)
+        return qc
+
 
 def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
     x = jnp.asarray(x)
@@ -64,9 +130,7 @@ def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
     vmin = jnp.min(x, axis=0)
     vmax = jnp.max(x, axis=0)
     vscale = jnp.maximum(vmax - vmin, 1e-12) / 255.0
-    codes = jnp.clip(
-        jnp.round((x - vmin[None, :]) / vscale[None, :]) - 128, -128, 127
-    ).astype(jnp.int8)
+    codes = sq_encode(x, vmin, vscale)
     labels_np, cents = np.asarray(out.labels), out.centroids
     if params.max_list_cap:
         labels_np, cents = split_oversized_lists(
@@ -79,26 +143,137 @@ def ivf_sq_build(x, params: IVFSQParams = IVFSQParams()) -> IVFSQIndex:
     return IVFSQIndex(cents, codes_sorted, vmin, vscale, storage)
 
 
+def _resolve_sq_engine(use_pallas, d: int, qcap: int) -> bool:
+    """Resolve the ``use_pallas`` knob of the grouped SQ searches to a
+    concrete engine choice (a trace-time static) — the SQ sibling of
+    :func:`raft_tpu.spatial.ann.ivf_flat._resolve_scan_engine`, backed by
+    the SAME shared planner (``scan_core.plan_l_tile`` through the SQ
+    engine's byte model).
+
+    ``None`` (auto): the int8 Pallas dequant+scan engine (spatial/ann/
+    sq_kernel) on a TPU backend whenever the config fits the kernel's
+    VMEM plan; the XLA dequant scan otherwise — ``JAX_PLATFORMS=cpu``
+    never imports the kernel module unless a caller opts in explicitly.
+    ``True`` validates the planner requirement and raises NAMING it when
+    it does not hold (explicit opt-in must not silently fall back).
+    ``False`` pins the XLA dequant scan."""
+    if use_pallas is None:
+        if jax.default_backend() != "tpu":
+            return False
+        from raft_tpu.spatial.ann.sq_kernel import sq_scan_supported
+
+        return sq_scan_supported(d, qcap)
+    if use_pallas:
+        from raft_tpu.spatial.ann.sq_kernel import sq_scan_supported
+
+        errors.expects(
+            sq_scan_supported(d, qcap),
+            "use_pallas=True unsupported at d=%d qcap=%d: "
+            "sq_kernel.sq_scan_supported is False — one int8 slab tile "
+            "+ its in-VMEM bf16 dequant + the query block exceed the "
+            "shared planner's VMEM budget (scan_core.plan_l_tile "
+            "returned None even at the 128-row floor); use the XLA "
+            "dequant scan (use_pallas=False)", d, qcap,
+        )
+    return bool(use_pallas)
+
+
+def _flat_view(index: IVFSQIndex):
+    """The IVF-Flat pytree view of an SQ index: the ONE grouped scan
+    body (:func:`...ivf_flat._grouped_impl`) consumes it with the
+    ``dequant`` runtime pair carrying the affine map. ``data_sorted``
+    holds the int8 codes — the XLA path dequantizes sliced slab blocks,
+    the kernel path hands them to ``sq_kernel`` untouched."""
+    from raft_tpu.spatial.ann.ivf_flat import IVFFlatIndex
+
+    return IVFFlatIndex(
+        centroids=index.centroids,
+        data_sorted=index.codes_sorted,
+        storage=index.storage,
+        metric="sqeuclidean",     # SQ distances are squared, like PQ's
+    )
+
+
+def ivf_sq_search_grouped(
+    index: IVFSQIndex, queries, k: int, *, n_probes: int = 8,
+    qcap: typing.Union[int, str, None] = None, list_block: int = 32,
+    stream_partials: typing.Optional[bool] = None,
+    qcap_max_drop_frac: typing.Optional[float] = None,
+    use_pallas: typing.Optional[bool] = None,
+    rerank_ratio: float = 4.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Throughput-mode (list-major) IVF-SQ search — the SQ instantiation
+    of the ONE grouped scan body shared with IVF-Flat
+    (:func:`raft_tpu.spatial.ann.ivf_flat._grouped_impl` with the
+    ``dequant`` runtime pair; ISSUE 11). Returns (squared L2 distances
+    over the dequantized vectors, row ids), exactly the per-query
+    :func:`ivf_sq_search` semantics at the grouped engine's throughput.
+
+    ``use_pallas`` selects the scan engine (docs/ivf_scale.md "One
+    scan-kernel core"): ``None`` (auto) runs the int8 Pallas
+    dequant+scan kernel (spatial/ann/sq_kernel) on a TPU backend
+    whenever the config fits its VMEM plan — int8 slab tiles cross HBM
+    at one byte per element and expand to bf16 only in VMEM, and the
+    top-``c`` sub-chunks' rows are rescored against f32-dequantized
+    values at HIGHEST precision, so returned distances are exactly the
+    XLA path's. ``False`` pins the XLA dequant scan (the CPU fallback);
+    ``True`` opts in explicitly (interpret mode off-TPU) and raises
+    naming the unmet planner requirement when it does not hold.
+    ``rerank_ratio`` sizes the kernel path's rerank pool, as in the
+    flat engine."""
+    q = jnp.asarray(queries)
+    errors.check_matrix(q, "queries")
+    errors.check_same_cols(q, index.centroids, "queries", "index")
+    storage = index.storage
+    if k > storage.max_list:
+        # a single list cannot fill a per-list top-k row
+        errors.expects(
+            not use_pallas,
+            "use_pallas=True: k=%d > max_list=%d routes to the "
+            "per-query SQ search, which has no kernel path; lower k or "
+            "rebuild with fewer lists", k, storage.max_list,
+        )
+        return ivf_sq_search(index, q, k, n_probes=n_probes)
+    n_lists = storage.list_index.shape[0]
+    from raft_tpu.spatial.ann.common import resolve_qcap_arg
+    from raft_tpu.spatial.ann.ivf_flat import _grouped_impl
+
+    qcap, probes = resolve_qcap_arg(
+        qcap, q, index.centroids, n_lists, n_probes,
+        max_drop_frac=qcap_max_drop_frac,
+    )
+    list_block = max(1, min(list_block, n_lists))
+    use_pallas = _resolve_sq_engine(
+        use_pallas, index.centroids.shape[1], qcap
+    )
+    return _grouped_impl(
+        _flat_view(index), q, k, n_probes, qcap, list_block,
+        probes=probes, stream_partials=stream_partials,
+        use_pallas=use_pallas,
+        pallas_interpret=jax.default_backend() != "tpu",
+        rerank_ratio=float(rerank_ratio),
+        dequant=(jnp.asarray(index.vmin, jnp.float32),
+                 jnp.asarray(index.vscale, jnp.float32)),
+    )
+
+
 def ivf_sq_search(
     index: IVFSQIndex, queries, k: int, *, n_probes: int = 8,
     block_q: int = 512, use_pallas: typing.Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-query IVF-SQ search (dequantization fused into candidate
-    scoring). ``use_pallas`` exists only to fail LOUDLY: the SQ engine
-    stores int8 codes, and the Pallas flat-scan kernel's shared block_fn
-    (spatial/ann/flat_kernel) contracts raw bf16 slab rows — routing SQ
-    codes through it would dequantize per list block and forfeit the
-    int8 memory win, so the engine has no kernel path and the rollout
-    must not silently skip it. ``None``/``False`` run the XLA path
-    (identical results); ``True`` raises naming the unmet requirement
-    (tested in tests/test_flat_kernel.py so the gap stays visible)."""
+    scoring). The Pallas int8 dequant+scan engine lives in the GROUPED
+    search (:func:`ivf_sq_search_grouped` — the kernel scans whole
+    list slabs, which the per-query candidate gather never forms), so
+    ``use_pallas`` here exists only to fail LOUDLY: ``True`` raises
+    pointing at the grouped entry instead of silently serving the
+    gather-bound path; ``None``/``False`` run the XLA path."""
     errors.expects(
         not use_pallas,
-        "use_pallas=True: the int8 IVF-SQ engine has no Pallas scan "
-        "path — the flat kernel's block_fn scans raw bf16 slabs, not "
-        "SQ codes (dequantizing per block would forfeit the int8 "
-        "memory win); use IVF-Flat for the kernel engine, or "
-        "use_pallas=False here",
+        "use_pallas=True: the per-query SQ search has no kernel path — "
+        "the int8 dequant+scan engine (spatial/ann/sq_kernel) scans "
+        "whole list slabs, which only the list-major grouped search "
+        "forms; use ivf_sq_search_grouped(use_pallas=True)",
     )
     return _sq_search_impl(index, queries, k, n_probes=n_probes,
                            block_q=block_q)
@@ -123,10 +298,7 @@ def _sq_search_impl(
         cand_pos = index.storage.list_index[probes].reshape(qb.shape[0], -1)
         codes = index.codes_sorted[cand_pos].astype(jnp.float32)
         # dequantization fused into candidate scoring
-        cand = (
-            (codes + 128.0) * index.vscale[None, None, :]
-            + index.vmin[None, None, :]
-        )
+        cand = sq_decode(codes, index.vmin, index.vscale)
         d2 = score_l2_candidates(qf, cand, cand_pos < index.storage.n)
         return select_candidates(index.storage, cand_pos, d2, k)
 

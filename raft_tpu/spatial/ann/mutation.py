@@ -69,6 +69,11 @@ from raft_tpu.spatial.ann.ivf_pq import (
     _pq_grouped_impl,
     _resolve_adc_engine,
 )
+from raft_tpu.spatial.ann.ivf_sq import (
+    IVFSQIndex,
+    _flat_view,
+    _resolve_sq_engine,
+)
 
 __all__ = [
     "DeltaStore",
@@ -126,7 +131,7 @@ class MutableIndex:
     previous main copy in-graph.
     """
 
-    index: typing.Union[IVFFlatIndex, IVFPQIndex]
+    index: typing.Union[IVFFlatIndex, IVFPQIndex, IVFSQIndex]
     delta: DeltaStore
     row_mask: jax.Array   # (n + 1,) int8 live mask
     id_to_pos: jax.Array  # (id_span,) int32, -1 = absent
@@ -142,7 +147,11 @@ class MutableIndex:
 
     @property
     def engine(self) -> str:
-        return "pq" if isinstance(self.index, IVFPQIndex) else "flat"
+        if isinstance(self.index, IVFPQIndex):
+            return "pq"
+        if isinstance(self.index, IVFSQIndex):
+            return "sq"
+        return "flat"
 
 
 def _with(mindex: MutableIndex, **kw) -> MutableIndex:
@@ -154,17 +163,21 @@ def _with(mindex: MutableIndex, **kw) -> MutableIndex:
 
 
 def wrap_mutable(index, *, delta_cap: int = 32) -> MutableIndex:
-    """Wrap a frozen :class:`IVFFlatIndex` / :class:`IVFPQIndex` for
-    online mutation. Host-side (one inverse-permutation pass over
-    ``sorted_ids``); the wrapped index's arrays are aliased, not copied.
+    """Wrap a frozen :class:`IVFFlatIndex` / :class:`IVFPQIndex` /
+    :class:`IVFSQIndex` for online mutation. Host-side (one
+    inverse-permutation pass over ``sorted_ids``); the wrapped index's
+    arrays are aliased, not copied. SQ delta rows are stored as exact
+    f32 until compaction re-quantizes them — a fresh row serves at full
+    precision, and only the fold pays the affine rounding.
 
     ``delta_cap``: static per-list delta capacity. Upserts into a full
     segment are REJECTED (reported via the accepted mask) until
     compaction drains it — size it from the expected ingest rate between
     compactions (docs/mutation.md "Capacity tuning")."""
     errors.expects(
-        isinstance(index, (IVFFlatIndex, IVFPQIndex)),
-        "wrap_mutable: expected an IVFFlatIndex or IVFPQIndex, got %s",
+        isinstance(index, (IVFFlatIndex, IVFPQIndex, IVFSQIndex)),
+        "wrap_mutable: expected an IVFFlatIndex, IVFPQIndex, or "
+        "IVFSQIndex, got %s",
         type(index).__name__,
     )
     errors.expects(delta_cap >= 1, "delta_cap=%d < 1", delta_cap)
@@ -401,6 +414,17 @@ def _mut_search_impl(index, delta, row_mask, q, k, n_probes, qcap,
             index, qf, k, n_probes, qcap, list_block, row_mask=row_mask,
             use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         )
+    elif engine == "sq":
+        # the SQ mode of the one grouped scan body: same tombstone
+        # contract as the flat branch (kernel path masks per ROW at the
+        # exact rerank tail, which also dequantizes through the affine
+        # map — a dead row can crowd a pool slot, never surface)
+        mv, mi = _grouped_impl(
+            _flat_view(index), qf, k, n_probes, qcap, list_block,
+            row_mask=row_mask, use_pallas=use_pallas,
+            pallas_interpret=pallas_interpret,
+            dequant=(index.vmin.astype(f32), index.vscale.astype(f32)),
+        )
     else:
         mv, mi = _pq_grouped_impl(
             index, qf, k, n_probes, qcap, list_block, refine_ratio,
@@ -439,10 +463,12 @@ def mutable_search(
     tests/test_mutation.py). ``qcap`` resolves SHAPE-ONLY
     (:func:`...common.static_qcap`) — the mutation tier is a serving
     workload, and the data-dependent auto path would host-sync per
-    dispatch. ``use_pallas`` selects the frozen scan's engine for BOTH
-    index kinds (the PQ ADC kernel / the flat sub-chunk-min kernel);
-    either kernel path applies the tombstone ``row_mask`` at its exact
-    rerank tail — a dead row can crowd a pool slot, never surface."""
+    dispatch. ``use_pallas`` selects the frozen scan's engine for ALL
+    THREE index kinds (the PQ ADC kernel / the flat sub-chunk-min
+    kernel / the int8 SQ dequant+scan kernel); every kernel path
+    applies the tombstone ``row_mask`` at its exact rerank tail — a
+    dead row can crowd a pool slot, never surface. SQ returns squared
+    distances over the dequantized vectors, like its grouped search."""
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
     errors.check_same_cols(q, mindex.index.centroids, "queries", "index")
@@ -456,8 +482,8 @@ def mutable_search(
     )
     nl = index.centroids.shape[0]
     qc = static_qcap(qcap, q.shape[0], n_probes, nl)
-    lb = list_block if list_block is not None else (32 if engine == "flat"
-                                                   else 8)
+    lb = list_block if list_block is not None else (8 if engine == "pq"
+                                                   else 32)
     lb = max(1, min(lb, nl))
     if engine == "pq":
         refine_active = (
@@ -472,6 +498,13 @@ def mutable_search(
             up, jax.default_backend() != "tpu",
         )
         return vals, ids
+    if engine == "sq":
+        up = _resolve_sq_engine(use_pallas, index.centroids.shape[1], qc)
+        return _mut_search_impl(
+            index, mindex.delta, mindex.row_mask, q, k, n_probes, qc, lb,
+            "sq", refine_ratio, exact_selection, approx_recall_target,
+            up, jax.default_backend() != "tpu",
+        )
     up = _resolve_scan_engine(use_pallas, index.centroids.shape[1], qc)
     vals, ids = _mut_search_impl(
         index, mindex.delta, mindex.row_mask, q, k, n_probes, qc, lb,
@@ -648,6 +681,17 @@ def compact(
     keep = np.nonzero(rm & (sids >= 0))[0]
     if engine == "flat":
         base_rows = np.asarray(index.data_sorted)[keep]
+    elif engine == "sq":
+        # survivors keep their stored codes VERBATIM through the fold
+        # (stashed here, re-permuted below — decode->re-encode would
+        # drift a code unit when |vmin| dwarfs the dimension's range);
+        # the dequantized rows are needed only for (re)assignment
+        from raft_tpu.spatial.ann.ivf_sq import sq_decode
+
+        codes_keep = np.asarray(index.codes_sorted)[keep]
+        base_rows = np.asarray(sq_decode(
+            codes_keep.astype(np.float32), index.vmin, index.vscale,
+        ))
     else:
         errors.expects(
             index.vectors_sorted is not None,
@@ -727,6 +771,29 @@ def compact(
             )),
             storage=st,
             metric=index.metric,
+        )
+    elif engine == "sq":
+        # survivors carry their stored codes verbatim; ONLY the delta
+        # rows pay the quantization step they deferred at ingest,
+        # against the KEPT stats through THE shared encoder (the
+        # PQ-codebook rule applied to the affine map: compaction never
+        # retrains the quantizer, only the coarse centroids may refresh)
+        from raft_tpu.spatial.ann.ivf_sq import sq_encode
+
+        codes_all = np.concatenate([
+            codes_keep,
+            np.asarray(sq_encode(dvecs, index.vmin, index.vscale)),
+        ])                                       # aligned with x's rows
+        codes_new = np.concatenate([
+            codes_all[order],
+            np.zeros((pad + 1, d), np.int8),     # pad + sentinel rows
+        ])
+        new_index = IVFSQIndex(
+            centroids=jnp.asarray(cents_new),
+            codes_sorted=jnp.asarray(codes_new),
+            vmin=index.vmin,
+            vscale=index.vscale,
+            storage=st,
         )
     else:
         codes_sorted = np.concatenate(

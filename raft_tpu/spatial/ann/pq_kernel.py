@@ -1,7 +1,12 @@
 """Pallas ADC engine for IVF-PQ — the PQ port of the ``fused_knn``
 two-phase recipe (ROADMAP item 4; reference: the interleaved-scan ADC
 kernels under cpp/include/raft/neighbors/detail/ivf_pq_compute_similarity,
-SURVEY §12/§17).
+SURVEY §12/§17). Since ISSUE 11 the engine is a thin instantiation of
+the shared scan-kernel core (:mod:`raft_tpu.spatial.ann.scan_core`): the
+tile planner, the [lo, hi) slab masking, the 8-row sub-chunk-min select,
+and the lax-mirror discipline live there once; this module contributes
+only the ADC distance computation (VMEM one-hot expansion + bf16 LUT
+contraction).
 
 Why a kernel: the XLA grouped-ADC path materializes a one-hot expansion of
 every scanned code block in HBM — (L, M·2^bits) bf16 per list, ~hundreds
@@ -46,32 +51,22 @@ only when they explicitly opt in with ``use_pallas=True``.
 from __future__ import annotations
 
 import functools
+import typing
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.spatial.ann import scan_core
+from raft_tpu.spatial.ann.scan_core import (
+    BIG as BIG,  # re-export: callers read the masked-row constant here
+    SUBCHUNK,
+    pad_queries,
+)
 
 __all__ = [
     "SUBCHUNK", "plan_l_tile", "pq_adc_subchunk_min",
     "pq_adc_subchunk_min_lax", "pq_adc_supported",
 ]
-
-SUBCHUNK = 8      # rows per selection granule (f32 sublane width)
-_LANE = 128       # code-tile rows must be lane-aligned
-_Q_GRANULE = 16   # bf16 sublane tile: the LUT's query axis pads to this
-
-# Masked rows score a finite BIG (never +inf: inf - inf NaNs on the VPU,
-# and the pooled approx_min_k must still order masked sub-chunks last).
-BIG = 1e30
-
-# VMEM working-set budget for one grid step (one-hot tile + LUT block +
-# distance tile), double-buffering headroom included. ~16 MB/core total.
-_VMEM_BUDGET = 10 * 2**20
-
-
-def _round_up(a: int, b: int) -> int:
-    return -(-a // b) * b
 
 
 def _step_bytes(mk: int, q_pad: int, l_tile: int) -> int:
@@ -80,58 +75,33 @@ def _step_bytes(mk: int, q_pad: int, l_tile: int) -> int:
     return 2 * mk * l_tile + 2 * 2 * q_pad * mk + 4 * q_pad * l_tile
 
 
-def plan_l_tile(mk: int, q_pad: int, l_tile: int = 512):
-    """Largest code-tile width (a multiple of 128, <= ``l_tile``) whose
-    per-step working set fits the VMEM budget; None when even a 128-row
-    tile does not fit (very wide M·K — the caller falls back to the XLA
-    one-hot path)."""
-    lt = max(_LANE, _round_up(min(l_tile, 512), _LANE))
-    while lt > _LANE and _step_bytes(mk, q_pad, lt) > _VMEM_BUDGET:
-        # halve, re-aligned down to the lane width (a non-128-multiple
-        # start like 384 must not yield an unusable 192-row tile)
-        lt = max(_LANE, (lt // 2) // _LANE * _LANE)
-    if _step_bytes(mk, q_pad, lt) > _VMEM_BUDGET:
-        return None
-    return lt
+def plan_l_tile(mk: int, q_pad: int,
+                l_tile: typing.Optional[int] = None,
+                profile: str = "throughput"):
+    """The ADC engine's byte model handed to the ONE shared planner
+    (:func:`raft_tpu.spatial.ann.scan_core.plan_l_tile`): largest
+    lane-aligned code-tile width whose per-step working set fits the
+    VMEM budget, from the profile's start width (512 throughput / 1024
+    latency); None when even a 128-row tile does not fit (very wide
+    M·K — the caller falls back to the XLA one-hot path)."""
+    return scan_core.plan_l_tile(
+        functools.partial(_step_bytes, mk), q_pad, l_tile, profile
+    )
 
 
 def pq_adc_supported(pq_dim: int, pq_bits: int, qcap: int) -> bool:
     """Whether the Pallas ADC engine applies at this config: codes are
     uint8 (pq_bits <= 8 — the index invariant) and one (LUT block,
-    one-hot tile) step fits VMEM."""
+    one-hot tile) step fits VMEM under the profile the grouped path
+    would auto-select for this qcap (``scan_core.tile_profile``; the
+    plan only shrinks from the profile start, so supportedness is
+    profile-independent in truth value)."""
     if not (1 <= pq_bits <= 8):
         return False
     mk = pq_dim * (1 << pq_bits)
-    q_pad = _round_up(max(qcap, 1), _Q_GRANULE)
-    return plan_l_tile(mk, q_pad) is not None
-
-
-def _adc_kernel(bounds_ref, lut_ref, codes_ref, kidx_ref, o_ref, *,
-                l_tile: int, sub: int):
-    """One (list b, code-tile t) grid step: VMEM one-hot expansion, MXU
-    LUT contraction, slab-range masking, sub-chunk min — nothing but the
-    (Q, Lt/sub) minima is written out."""
-    b = pl.program_id(0)
-    t = pl.program_id(1)
-    codes = codes_ref[0]                      # (M, Lt) u8
-    m_dim = codes.shape[0]
-    k_dim = kidx_ref.shape[0]
-    # one-hot[m*K + k, l] = (codes[m, l] == k): a u8 compare against the
-    # constant (K, 1) index column — the byte-index gather, spelled as an
-    # MXU operand (Mosaic on this toolchain has no dynamic-gather
-    # lowering; the expansion is VMEM-only, which is the point)
-    oh = (codes[:, None, :] == kidx_ref[:][None, :, :])        # (M, K, Lt)
-    ohf = oh.reshape(m_dim * k_dim, l_tile).astype(jnp.bfloat16)
-    d2 = jax.lax.dot_general(
-        lut_ref[0], ohf, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                          # (Q, Lt) f32
-    lo = bounds_ref[b, 0]
-    hi = bounds_ref[b, 1]
-    col = t * l_tile + jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
-    d2 = jnp.where((col >= lo) & (col < hi), d2, jnp.float32(BIG))
-    q_pad = d2.shape[0]
-    o_ref[0] = jnp.min(d2.reshape(q_pad, l_tile // sub, sub), axis=2)
+    return plan_l_tile(
+        mk, pad_queries(qcap), profile=scan_core.tile_profile(qcap)
+    ) is not None
 
 
 def pq_adc_subchunk_min(luts, codes_t, bounds, *, interpret: bool,
@@ -145,13 +115,7 @@ def pq_adc_subchunk_min(luts, codes_t, bounds, *, interpret: bool,
     (itself a multiple of 128) — the caller pads; padded query rows
     produce garbage-but-finite minima the caller drops."""
     lb, q_pad, mk = luts.shape
-    m_dim, l_pad = codes_t.shape[1], codes_t.shape[2]
-    if q_pad % _Q_GRANULE or l_pad % l_tile or l_tile % _LANE:
-        raise ValueError(
-            f"pq_adc_subchunk_min: Q={q_pad} must be a multiple of "
-            f"{_Q_GRANULE} and Lpad={l_pad} a multiple of "
-            f"l_tile={l_tile} (itself a multiple of {_LANE})"
-        )
+    m_dim = codes_t.shape[1]
     if mk % m_dim:
         raise ValueError(
             f"pq_adc_subchunk_min: LUT width {mk} is not a multiple of "
@@ -159,36 +123,41 @@ def pq_adc_subchunk_min(luts, codes_t, bounds, *, interpret: bool,
         )
     k_dim = mk // m_dim
     kidx = jnp.arange(k_dim, dtype=jnp.uint8)[:, None]         # (K, 1)
-    kernel = functools.partial(_adc_kernel, l_tile=l_tile, sub=SUBCHUNK)
-    nsc_t = l_tile // SUBCHUNK
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(lb, l_pad // l_tile),
-            in_specs=[
-                pl.BlockSpec((1, q_pad, mk), lambda b, t, bnd: (b, 0, 0)),
-                pl.BlockSpec((1, m_dim, l_tile),
-                             lambda b, t, bnd: (b, 0, t)),
-                pl.BlockSpec((k_dim, 1), lambda b, t, bnd: (0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, q_pad, nsc_t),
-                                   lambda b, t, bnd: (b, 0, t)),
-        ),
-        out_shape=jax.ShapeDtypeStruct(
-            (lb, q_pad, l_pad // SUBCHUNK), jnp.float32
-        ),
-        interpret=interpret,
-    )(bounds.astype(jnp.int32), luts.astype(jnp.bfloat16), codes_t, kidx)
-    return out
+
+    def tile_fn(res, til, bc):
+        lut = res[0]                          # (Qp, MK) bf16
+        codes = til[0]                        # (M, Lt)  u8
+        kcol = bc[0]                          # (K, 1)   u8
+        m = codes.shape[0]
+        kd = kcol.shape[0]
+        lt = codes.shape[1]
+        # one-hot[m*K + k, l] = (codes[m, l] == k): a u8 compare against
+        # the constant (K, 1) index column — the byte-index gather,
+        # spelled as an MXU operand (Mosaic on this toolchain has no
+        # dynamic-gather lowering; the expansion is VMEM-only, which is
+        # the point)
+        oh = (codes[:, None, :] == kcol[None, :, :])           # (M, K, Lt)
+        ohf = oh.reshape(m * kd, lt).astype(jnp.bfloat16)
+        return jax.lax.dot_general(
+            lut, ohf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                      # (Q, Lt) f32
+
+    return scan_core.subchunk_scan(
+        tile_fn, bounds,
+        [luts.astype(jnp.bfloat16)], [codes_t], [kidx],
+        l_tile=l_tile, interpret=interpret,
+        name="pq_adc_subchunk_min",
+    )
 
 
 def pq_adc_subchunk_min_lax(luts, codes_t, bounds):
     """Op-for-op XLA mirror of :func:`pq_adc_subchunk_min` (same one-hot
     expansion, same bf16 contraction with f32 accumulation, same masking
-    and sub-chunk reduce) — the bit-compat reference the tier-1 tests pin
-    the interpret-mode kernel against, and the engine's fallback wherever
-    ``pallas_call`` is unavailable."""
+    and sub-chunk reduce via ``scan_core.mask_subchunk_min_lax``) — the
+    bit-compat reference the tier-1 tests pin the interpret-mode kernel
+    against, and the engine's fallback wherever ``pallas_call`` is
+    unavailable."""
     lb, q_pad, mk = luts.shape
     m_dim, l_pad = codes_t.shape[1], codes_t.shape[2]
     k_dim = mk // m_dim
@@ -199,9 +168,4 @@ def pq_adc_subchunk_min_lax(luts, codes_t, bounds):
         luts.astype(jnp.bfloat16), ohf, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )                                                          # (LB, Q, Lp)
-    col = jnp.arange(l_pad, dtype=jnp.int32)[None, None, :]
-    lo = bounds[:, 0][:, None, None]
-    hi = bounds[:, 1][:, None, None]
-    d2 = jnp.where((col >= lo) & (col < hi), d2, jnp.float32(BIG))
-    return jnp.min(d2.reshape(lb, q_pad, l_pad // SUBCHUNK, SUBCHUNK),
-                   axis=3)
+    return scan_core.mask_subchunk_min_lax(d2, bounds)

@@ -72,12 +72,17 @@ def _register_sharded() -> None:
     # re-place onto a mesh with comms.mnmg_ivf.place_index before search.
     if "mnmg_ivf_pq" not in _TYPES:
         from raft_tpu.comms.mnmg_ivf import MnmgIVFPQIndex
-        from raft_tpu.comms.mnmg_ivf_flat import MnmgIVFFlatIndex
+        from raft_tpu.comms.mnmg_ivf_flat import (
+            MnmgIVFFlatIndex,
+            MnmgIVFSQIndex,
+        )
 
         _TYPES["mnmg_ivf_pq"] = MnmgIVFPQIndex
         _NAMES[MnmgIVFPQIndex] = "mnmg_ivf_pq"
         _TYPES["mnmg_ivf_flat"] = MnmgIVFFlatIndex
         _NAMES[MnmgIVFFlatIndex] = "mnmg_ivf_flat"
+        _TYPES["mnmg_ivf_sq"] = MnmgIVFSQIndex
+        _NAMES[MnmgIVFSQIndex] = "mnmg_ivf_sq"
 
 
 def _register_mutable() -> None:
@@ -93,6 +98,7 @@ def _register_mutable() -> None:
         # the wrapped engine index nests inside the mutable payload
         _NESTED["IVFFlatIndex"] = IVFFlatIndex
         _NESTED["IVFPQIndex"] = IVFPQIndex
+        _NESTED["IVFSQIndex"] = IVFSQIndex
 
 
 _NAMES = {v: k for k, v in _TYPES.items()}

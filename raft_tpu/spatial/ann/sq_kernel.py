@@ -1,0 +1,171 @@
+"""Pallas scan engine for IVF-SQ — int8 in-kernel dequant+scan (ISSUE
+11; the engine that closes the ``use_pallas`` gap PR 10 left loudly
+visible). Built directly on the shared scan-kernel core
+(:mod:`raft_tpu.spatial.ann.scan_core`): the tile planner, the [lo, hi)
+slab masking, the 8-row sub-chunk-min select, and the lax-mirror
+discipline are the same pieces the flat and ADC engines use; this
+module contributes only the SQ distance computation — an affine int8
+dequant on the VPU feeding the flat engine's bf16 gram.
+
+Why in-kernel dequant: the SQ index's whole value is its int8 slabs —
+one byte per dimension, HALF the bf16 flat engine's HBM footprint and
+slab traffic (it compounds directly with the billion-vector budget math
+of ROADMAP item 4). Dequantizing in XLA before a scan would materialize
+a full-width f32/bf16 copy of every scanned slab through HBM, forfeiting
+exactly that win; dequantizing per gathered candidate (the per-query
+path) is gather-bound. Here the int8 tile is DMA'd to VMEM at one byte
+per element and expanded only there:
+
+* the per-(list, query-slot) **bf16 query rows** are loaded once per
+  list and stay VMEM-resident across its slab tiles (the flat engine's
+  layout, unchanged);
+* the **int8 code tile** ``(d, Lt)`` is dequantized on the VPU —
+  ``y = (code + 128) · vscale + vmin`` per dimension, the QT_8bit
+  affine map, computed in f32 and rounded once to bf16 — with the
+  per-dimension ``vscale``/``vmin`` columns resident across the whole
+  grid;
+* the dequantized tile feeds the SAME MXU gram + f32 norm terms as the
+  flat engine, the driver masks rows outside ``[lo, hi)`` to a finite
+  BIG, and min-reduces 8-row sub-chunks in-kernel — only the
+  (Q, Lpad/8) minima reach HBM.
+
+Exactness contract: identical to the flat engine's, over the
+*dequantized* vectors (which are what the SQ index stores — the affine
+map is the index's lossy step, not the kernel's). The bf16 rounding of
+the dequantized tile perturbs only candidate ranking near the pool
+boundary (absorbed by the 8-row over-fetch + ``rerank_ratio`` margin);
+the search tail rescores covered rows against f32-dequantized values at
+HIGHEST precision, so returned distances are exactly the XLA SQ path's.
+On inputs whose dequantized values are bf16-exact dyadics (a
+power-of-two ``vscale``), saturated pools are bit-identical between
+engines — the tier-1 pin, same discipline as the flat engine.
+
+CPU/tier-1: the kernel runs under ``interpret=True``, and
+:func:`sq_scan_subchunk_min_lax` is the op-for-op XLA mirror the tests
+pin the kernel against bitwise. Importing this module never builds a
+TPU program; ``JAX_PLATFORMS=cpu`` callers reach it only when they
+explicitly opt in with ``use_pallas=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax.numpy as jnp
+
+from raft_tpu.spatial.ann import scan_core
+from raft_tpu.spatial.ann.scan_core import (
+    BIG as BIG,  # re-export: callers read the masked-row constant here
+    SUBCHUNK,
+    pad_queries,
+)
+
+__all__ = [
+    "SUBCHUNK", "pad_queries", "plan_l_tile", "sq_scan_subchunk_min",
+    "sq_scan_subchunk_min_lax", "sq_scan_supported",
+]
+
+
+def _step_bytes(d: int, q_pad: int, l_tile: int) -> int:
+    # int8 slab tile (d, Lt) (x2: pipelined block) + its dequantized
+    # bf16 expansion (d, Lt) + query rows (Qp, d) bf16 (x2: resident
+    # across tiles, double-buffered per list) + d2 (Qp, Lt) f32 +
+    # vscale/vmin columns (d, 1) f32 (< 1%, ignored)
+    return (2 * d * l_tile + 2 * d * l_tile
+            + 2 * 2 * q_pad * d + 4 * q_pad * l_tile)
+
+
+def plan_l_tile(d: int, q_pad: int,
+                l_tile: typing.Optional[int] = None,
+                profile: str = "throughput"):
+    """The SQ engine's byte model handed to the ONE shared planner
+    (:func:`raft_tpu.spatial.ann.scan_core.plan_l_tile`): largest
+    lane-aligned slab-tile width whose per-step working set — int8 tile
+    + its in-VMEM bf16 dequant + query block + distance tile — fits the
+    VMEM budget; None when even a 128-row tile does not fit (the caller
+    falls back to the XLA dequant scan)."""
+    return scan_core.plan_l_tile(
+        functools.partial(_step_bytes, d), q_pad, l_tile, profile
+    )
+
+
+def sq_scan_supported(d: int, qcap: int) -> bool:
+    """Whether the Pallas SQ engine applies at this config: one (query
+    block, int8 slab tile) step fits the VMEM plan under the profile
+    the grouped path would auto-select for this qcap (the shared
+    ``scan_core.tile_profile`` / ``pad_queries`` rounding, so the
+    resolver's approval and the serving plan can never drift)."""
+    if d < 1:
+        return False
+    return plan_l_tile(
+        d, pad_queries(qcap), profile=scan_core.tile_profile(qcap)
+    ) is not None
+
+
+def _dequant_tile(codes, vmin_col, vscale_col):
+    """The QT_8bit affine map for one (d, Lt) int8 tile, f32 on the VPU,
+    rounded once to bf16 — shared verbatim by the kernel body and the
+    lax mirror so the two can never drift by an op."""
+    yf = (codes.astype(jnp.float32) + 128.0) * vscale_col + vmin_col
+    return yf.astype(jnp.bfloat16)
+
+
+def sq_scan_subchunk_min(qrows, codes_t, bounds, vmin, vscale, *,
+                         interpret: bool, l_tile: int = 256):
+    """(LB, Q, d) query rows x (LB, d, Lpad) int8 code slabs -> (LB, Q,
+    Lpad/8) f32 sub-chunk squared-L2 minima over the DEQUANTIZED
+    vectors (bf16 operands, f32 accumulation/norms).
+
+    ``vmin``/``vscale`` (d,) f32: the index's per-dimension affine
+    dequant parameters (``y = (code + 128) · vscale + vmin``), resident
+    in VMEM across the whole grid. ``bounds`` (LB, 2) int32: per-list
+    valid row range ``[lo, hi)`` relative to the slab window (rows
+    outside score BIG). Q must be a multiple of 16 and Lpad a multiple
+    of ``l_tile`` (itself a multiple of 128) — the caller pads; padded
+    query rows produce garbage-but-finite minima the caller drops."""
+    lb, q_pad, d = qrows.shape
+    d_s = codes_t.shape[1]
+    if d_s != d:
+        raise ValueError(
+            f"sq_scan_subchunk_min: query dim {d} != slab dim {d_s}"
+        )
+    if codes_t.dtype != jnp.int8:
+        raise ValueError(
+            f"sq_scan_subchunk_min: codes must be int8, got "
+            f"{codes_t.dtype}"
+        )
+    vmin_col = jnp.asarray(vmin, jnp.float32).reshape(d, 1)
+    vscale_col = jnp.asarray(vscale, jnp.float32).reshape(d, 1)
+
+    def tile_fn(res, til, bc):
+        qv = res[0]                           # (Qp, d)  bf16
+        codes = til[0]                        # (d, Lt)  int8
+        vm, vs = bc                           # (d, 1)   f32
+        y = _dequant_tile(codes, vm, vs)      # (d, Lt)  bf16, VPU
+        # the shared flat-family distance body over the dequantized tile
+        return scan_core.l2_gram_tile(qv, y)
+
+    return scan_core.subchunk_scan(
+        tile_fn, bounds,
+        [qrows.astype(jnp.bfloat16)], [codes_t],
+        [vmin_col, vscale_col],
+        l_tile=l_tile, interpret=interpret,
+        name="sq_scan_subchunk_min",
+    )
+
+
+def sq_scan_subchunk_min_lax(qrows, codes_t, bounds, vmin, vscale):
+    """Op-for-op XLA mirror of :func:`sq_scan_subchunk_min` (same f32
+    affine dequant rounded once to bf16 via the shared
+    :func:`_dequant_tile`, same bf16 contraction with f32 accumulation,
+    same masking and sub-chunk reduce via
+    ``scan_core.mask_subchunk_min_lax``) — the bit-compat reference the
+    tier-1 tests pin the interpret-mode kernel against, and the
+    engine's fallback wherever ``pallas_call`` is unavailable."""
+    lb, q_pad, d = qrows.shape
+    vmin_col = jnp.asarray(vmin, jnp.float32).reshape(1, d, 1)
+    vscale_col = jnp.asarray(vscale, jnp.float32).reshape(1, d, 1)
+    yb = _dequant_tile(codes_t, vmin_col, vscale_col)  # (LB, d, Lp) bf16
+    d2 = scan_core.l2_gram_tile(qrows.astype(jnp.bfloat16), yb)
+    return scan_core.mask_subchunk_min_lax(d2, bounds)

@@ -709,19 +709,51 @@ def test_wide_distance_suppression_honored():
     assert out == []
 
 
-def test_wide_distance_legacy_flat_scan_is_baselined():
-    """The one intentional legacy caller — the XLA grouped flat scan
-    kept as the use_pallas=False bit-stable engine — is grandfathered
-    in the committed baseline, and the repo lints clean against it."""
+def test_wide_distance_legacy_flat_scan_inline_suppressed():
+    """ISSUE 11 baseline burn-down: the one intentional legacy caller —
+    the XLA grouped flat scan kept as the use_pallas=False bit-stable
+    engine — is now INLINE-suppressed at its fixed spelling, so the
+    rule raises no finding over the ANN tree and the baseline no longer
+    grandfathers it (the coarse probe, the other wide-tile producer,
+    is kernelized through scan_core)."""
     result = lint_paths([REPO / "raft_tpu" / "spatial" / "ann"],
                         root=REPO)
     flagged = [f for f in result.findings
                if f.rule == "wide-distance-materialize"]
-    assert [f.path for f in flagged] == \
-        ["raft_tpu/spatial/ann/ivf_flat.py"]
+    assert flagged == []
     base = Baseline.load(REPO / "ci" / "checks" / "jaxlint_baseline.json")
-    new, old = base.filter(flagged)
-    assert new == [] and len(old) == 1
+    assert not any(
+        "wide-distance-materialize" in key for key in base.counts
+    ), "the burned-down baseline entry must not come back"
+
+
+def test_baseline_entries_match_live_findings_no_drift():
+    """The stale-baseline drift check (ISSUE 11 satellite): every entry
+    the committed baseline still grandfathers must match a LIVE finding
+    at its exact budgeted count — a baselined line that was since fixed
+    (or inline-suppressed) must be REMOVED from the baseline, or the
+    burn-down ratchet silently loosens. Conversely no live finding may
+    exceed its budget (the repo lints clean — CI's hard gate,
+    re-asserted here next to the drift direction it cannot see)."""
+    base = Baseline.load(REPO / "ci" / "checks" / "jaxlint_baseline.json")
+    result = lint_paths([REPO / "raft_tpu"], root=REPO)
+    live: dict = {}
+    for f in result.findings:
+        live[f.baseline_key] = live.get(f.baseline_key, 0) + 1
+    # no un-baselined findings (the CI gate) ...
+    new, old = base.filter(result.findings)
+    assert new == [], [f.baseline_key for f in new]
+    # ... and no STALE baseline budget: each entry fully consumed
+    for key, budget in base.counts.items():
+        assert live.get(key, 0) == budget, (
+            f"baseline entry no longer matches a live finding "
+            f"(live {live.get(key, 0)} != budget {budget}): {key}"
+        )
+    # the two remaining grandfathered findings are the legacy ADC
+    # gathers — the burn-down target of the next kernel milestone
+    assert sorted(base.counts) == sorted(
+        k for k in base.counts if "::adc-gather::" in k
+    ) and len(base.counts) == 2
 
 
 # -- mutation-retrace --------------------------------------------------------

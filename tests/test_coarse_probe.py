@@ -470,3 +470,157 @@ def test_two_level_probe_plays_with_throughput_audit(centroid_set):
         "throughput", q, jnp.asarray(centroid_set), 300, 4
     )
     assert isinstance(qc, int) and qc >= 1 and probes is None
+
+
+class TestKernelizedProbe:
+    """ISSUE 11: the two-level probe routed through the shared
+    scan-kernel core — the super scan as a one-slab sub-chunk-min
+    kernel, the member rerank as the mini-flat grouped body — pinned
+    against the legacy probe and, fused, against the XLA engines."""
+
+    def test_kernel_probe_matches_legacy(self, coarse, centroid_set):
+        from raft_tpu.spatial.ann.common import (
+            two_level_probe_kernel_supported,
+        )
+
+        rng = np.random.default_rng(5)
+        q = rng.standard_normal((130, 16)).astype(np.float32)
+        S = n_super_probes(8, coarse.n_super, 2.0)
+        assert two_level_probe_kernel_supported(
+            16, 130, 8, coarse.n_super, coarse.max_members, S
+        )
+        args = (coarse.super_cents, coarse.member_ids,
+                coarse.cents_padded, coarse.n_cents, 8, S)
+        p0, d0 = two_level_probe(q, *args)
+        p1, d1 = two_level_probe(q, *args, use_pallas=True,
+                                 pallas_interpret=True)
+        np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_kernel_probe_full_cover_degeneration(self, coarse,
+                                                  centroid_set):
+        """S = n_super through the kernel path still reranks every
+        centroid — probe set equals the flat scan's."""
+        rng = np.random.default_rng(6)
+        q = rng.standard_normal((32, 16)).astype(np.float32)
+        flat, _ = coarse_probe(jnp.asarray(q), jnp.asarray(centroid_set),
+                               8)
+        two, d2 = two_level_probe(
+            q, coarse.super_cents, coarse.member_ids, coarse.cents_padded,
+            coarse.n_cents, 8, coarse.n_super, use_pallas=True,
+            pallas_interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(flat), axis=1),
+            np.sort(np.asarray(two), axis=1),
+        )
+        assert np.isfinite(np.asarray(d2)).all()
+
+    def test_unsupported_geometry_degrades_to_legacy(self, coarse):
+        """use_pallas=True with a probe geometry the shared planner
+        rejects serves the legacy path silently — the probe is an
+        internal stage, never a loud-fail surface."""
+        from raft_tpu.spatial.ann.common import (
+            two_level_probe_kernel_supported,
+        )
+
+        assert not two_level_probe_kernel_supported(
+            1 << 20, 32, 8, coarse.n_super, coarse.max_members, 16
+        )
+        rng = np.random.default_rng(8)
+        q = rng.standard_normal((16, 16)).astype(np.float32)
+        S = n_super_probes(4, coarse.n_super, 2.0)
+        args = (coarse.super_cents, coarse.member_ids,
+                coarse.cents_padded, coarse.n_cents, 4, S)
+        # per-row pool too small for n_probes -> predicate rejects and
+        # the kernel flag must not change results
+        p0, _ = two_level_probe(q, *args)
+        p1, _ = two_level_probe(
+            q, *args, use_pallas=True, pallas_interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+
+    def test_fused_flat_search_with_kernel_probe_bit_identical(
+        self, comms8
+    ):
+        """The kernelized probe ACTIVE inside the fused one-dispatch
+        flat program (use_pallas=True engages scan kernel AND probe
+        kernel): saturated-pool results bit-identical to the
+        legacy-probe XLA-engine dispatch on an INTEGER-EXACT fixture
+        (every f32 accumulation exact regardless of order — the same
+        discipline as the engines' own bit-identity pins) — the
+        ISSUE 11 acceptance pin."""
+        from raft_tpu.comms import (
+            attach_coarse_index, mnmg_ivf_flat_build,
+            mnmg_ivf_flat_search,
+        )
+        from raft_tpu.spatial.ann import IVFFlatParams, flat_kernel
+
+        rng = np.random.default_rng(13)
+        x = rng.integers(-60, 60, (3000, 16)).astype(np.float32)
+        q = (x[:48] + rng.integers(-2, 3, (48, 16))).astype(np.float32)
+        idx = mnmg_ivf_flat_build(comms8, x, IVFFlatParams(
+            n_lists=32, kmeans_n_iters=4, kmeans_init="random",
+        ), metric="sqeuclidean")
+        cidx = attach_coarse_index(idx)
+        l_tile = flat_kernel.plan_l_tile(16, q.shape[0])
+        l_pad = -(-int(cidx.max_list) // l_tile) * l_tile
+        rr = float(8 * l_pad // flat_kernel.SUBCHUNK) / 5 + 1.0
+        kw = dict(n_probes=8, qcap=q.shape[0], rerank_ratio=rr)
+        v0, i0 = mnmg_ivf_flat_search(comms8, cidx, q, 5,
+                                      use_pallas=False, **kw)
+        v1, i1 = mnmg_ivf_flat_search(comms8, cidx, q, 5,
+                                      use_pallas=True, **kw)
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_fused_kernel_probe_health_flip_zero_retrace(
+        self, comms8, sharded_data, sharded_flat, monkeypatch
+    ):
+        """Health flips with the kernelized probe engaged reuse the one
+        compiled program — the probe's kernel/legacy choice is a
+        trace-time static, never a runtime branch."""
+        from raft_tpu.comms import attach_coarse_index
+        from raft_tpu.comms import mnmg_ivf_flat as mod
+
+        _, q = sharded_data
+        cidx = attach_coarse_index(sharded_flat)
+        created = []
+        orig = mod._cached_search
+
+        def recording(*a, **k):
+            fn = orig(*a, **k)
+            created.append(fn)
+            return fn
+
+        monkeypatch.setattr(mod, "_cached_search", recording)
+        kw = dict(n_probes=8, qcap=q.shape[0], use_pallas=True)
+        m_up = np.ones(8, np.int32)
+        m_one = m_up.copy()
+        m_one[5] = 0
+        mod.mnmg_ivf_flat_search(comms8, cidx, q, 5, shard_mask=m_up,
+                                 **kw)
+        fn = created[0]
+        size0 = fn._cache_size()
+        for mask in (m_one, m_up):
+            res = mod.mnmg_ivf_flat_search(comms8, cidx, q, 5,
+                                           shard_mask=mask, **kw)
+        assert all(f is fn for f in created)
+        assert fn._cache_size() == size0, \
+            "health flips must not retrace the kernel-probe program"
+        assert float(jnp.min(res.coverage)) == 1.0
+
+    def test_recall_audit_covers_kernelized_probe(self, coarse,
+                                                  centroid_set):
+        """coarse_probe_recall(use_pallas=True) audits the KERNELIZED
+        probe — the pre-rollout check for query-skewed workloads, where
+        the probe's shape-only qcap can drop marginal (query, super)
+        pairs. On this fixture (occupancy under the 4x-mean cap) both
+        probe engines must audit ~identically."""
+        rng = np.random.default_rng(17)
+        q = rng.standard_normal((96, 16)).astype(np.float32)
+        r_legacy = coarse_probe_recall(q, centroid_set, coarse, 8)
+        r_kernel = coarse_probe_recall(q, centroid_set, coarse, 8,
+                                       use_pallas=True)
+        assert abs(r_kernel - r_legacy) <= 0.01, (r_kernel, r_legacy)

@@ -387,18 +387,19 @@ def test_cpu_default_never_imports_kernel_module():
     assert "OK" in out.stdout
 
 
-# -- IVF-SQ: no kernel path, loudly ------------------------------------------
+# -- IVF-SQ: kernel lives in the grouped search; loud-fail names it ----------
 
-def test_ivf_sq_no_kernel_path_fails_loud(dataset):
-    """The int8 SQ engine has NO Pallas scan path (its codes are not
-    bf16 slab rows): ``use_pallas=True`` must raise naming the gap, and
-    ``None``/``False`` must run the XLA path with identical results —
-    the rollout cannot silently skip the engine."""
+def test_ivf_sq_per_query_use_pallas_points_at_grouped(dataset):
+    """Since ISSUE 11 the SQ engine HAS a kernel path — in the grouped
+    search (tests/test_sq_kernel.py). The per-query search still has
+    none (it never forms list slabs): ``use_pallas=True`` there must
+    raise POINTING AT the grouped entry, and ``None``/``False`` must
+    run the XLA path with identical results."""
     from raft_tpu.spatial.ann.ivf_sq import ivf_sq_search
 
     x, q = dataset
     idx = ivf_sq_build(x, IVFSQParams(n_lists=16, kmeans_n_iters=3))
-    with pytest.raises(Exception, match="no Pallas scan"):
+    with pytest.raises(Exception, match="ivf_sq_search_grouped"):
         ivf_sq_search(idx, q, K_NN, n_probes=4, use_pallas=True)
     d_def, i_def = ivf_sq_search(idx, q, K_NN, n_probes=4)
     d_none, i_none = ivf_sq_search(idx, q, K_NN, n_probes=4,

@@ -923,3 +923,110 @@ def test_round10_bench_line_parses_with_flat_scan_kernel():
                 "xla_recall_at_10"):
         assert key in c, key
     assert benchtop._compact(extras[8])["scan_engine"] == "pallas"
+
+
+def test_round11_bench_line_parses_with_sq_scan_kernel():
+    """ISSUE 11 satellite (the _fit_line parse/cap test extended,
+    following the r05-r10 pattern): the round-11 artifact shape — every
+    prior row PLUS the sq_scan_kernel acceptance row (the int8
+    dequant+scan engine vs its XLA dequant path) and the
+    ``probe_kernel`` stamp on both shard rows — must print as a line
+    that json.loads-round-trips under the 1800-char driver cap, with
+    the acceptance keys (kernel-vs-XLA speedup, the scan_engine stamp,
+    recall at both engines' operating point) surviving every trim
+    stage short of the last-resort core projection. ``probe_kernel``
+    is deliberately TRIMMABLE (a secondary stamp — the speedup rows
+    carry the acceptance signal) but prints whitelisted."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r11", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    serving_rows = [
+        {"engine": e, "nq": nq, "p50_ms": 1.2345, "spread": 0.08,
+         "repeats": 5, "qcap": 24}
+        for e in ("fused_knn", "ivf_flat", "ivf_pq")
+        for nq in (1, 128, 1024)
+    ] + [
+        {"engine": "ivf_flat", "scenario": "open_loop", "nq": 1024,
+         "program_qps": 610000.0, "saturation_qps": 512000.0,
+         "qps_ratio_vs_program": 0.839, "spread": 0.04, "repeats": 5,
+         "p50_ms_95": 4.2, "p99_ms_95": 14.6, "shed_rate_95": 0.012},
+    ]
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01,
+         "vs_prev_qcap8_qps": 0.99, "vs_prev_build_warm_s": 1.0}
+        for i in range(6)
+    ] + [
+        # the round-10 flat acceptance row, unchanged
+        {"metric": "flat_scan_kernel_500000x96_q4096_k10_p16",
+         "value": 104321.5, "unit": "QPS", "spread": 0.04, "repeats": 7,
+         "escalations": 1, "scan_engine": "pallas",
+         "recall_at_10": 0.9994, "xla_qps": 50620.9,
+         "xla_recall_at_10": 0.9994, "speedup": 2.06, "vs_prev": 1.0},
+        # the round-11 acceptance row, every key extra_sq_scan_kernel
+        # emits
+        {"metric": "sq_scan_kernel_500000x96_q4096_k10_p16",
+         "value": 98765.4, "unit": "QPS", "spread": 0.04, "repeats": 7,
+         "escalations": 1, "scan_engine": "pallas",
+         "recall_at_10": 0.9987, "xla_qps": 31234.5,
+         "xla_recall_at_10": 0.9988, "xla_spread": 0.05,
+         "speedup": 3.16, "index_gb": 0.05},
+        # both shard rows now stamp the probe engine too
+        {"metric": "mnmg_ivf_flat_shard_12500000x96_q16384_k10_p16",
+         "value": 50620.9, "unit": "QPS", "spread": 0.014, "repeats": 7,
+         "escalations": 1, "scan_engine": "pallas",
+         "probe_kernel": "pallas",
+         "recall_at_10_vs_shard": 0.9994, "build_s": 180.0,
+         "qcap8_qps": 130789.3, "measured_chip_qps": 1.2e5,
+         "sharded_e2e_qps": 1.1e5, "probe_recall_vs_flat": 0.997,
+         "probe_flop_ratio": 5.2, "vs_prev": 1.05},
+        {"metric": "mnmg_ivf_pq_shard_12500000x96_q16384_k10_p16",
+         "value": 11900.0, "unit": "QPS", "spread": 0.02, "repeats": 7,
+         "adc_engine": "pallas", "probe_kernel": "pallas",
+         "recall_at_10_vs_shard": 0.9575, "qcap8_qps": 15500.0,
+         "measured_chip_qps": 1.0e4, "sharded_e2e_qps": 0.95e4,
+         "probe_recall_vs_flat": 0.997, "vs_prev": 1.0},
+        {"metric": "serving_p50_500000x96_k10_p16", "unit": "ms",
+         "rows": serving_rows},
+        {"metric": "warm_start_build_500000x96", "unit": "s",
+         "value": 3.1, "build_warm_s": 1.9, "within_2x_warm": True},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    krow = next((e for e in parsed["extras"]
+                 if str(e.get("metric", "")).startswith(
+                     "sq_scan_kernel")), None)
+    assert krow is not None
+    assert krow["value"] == 98765.4         # primary survives any trim
+    if "speedup" in krow:                   # not core-projected
+        assert krow["speedup"] == 3.16
+        assert krow["scan_engine"] == "pallas"
+        assert krow["recall_at_10"] == 0.9987
+    for key in ("speedup", "scan_engine", "recall_at_10"):
+        assert key not in benchtop._TRIM_ORDER
+        assert key in benchtop._PRINT_KEYS
+    # probe_kernel prints whitelisted but IS trimmable under cap
+    # pressure (the acceptance signal lives in the speedup rows)
+    assert "probe_kernel" in benchtop._PRINT_KEYS
+    assert "probe_kernel" in benchtop._TRIM_ORDER
