@@ -1333,6 +1333,11 @@ _PRINT_KEYS = {
     "dcn_bytes_per_query", "dcn_bytes_ratio",
     "merge_ms_hier", "merge_ms_flat",
     "health_flip_retraces", "coverage_host_down", "host_down_bitident",
+    # the program-audit stamp (ISSUE 12, docs/static_analysis.md "Two
+    # tiers"): wall ms of the jaxpr-level contract gate run in a CPU
+    # subprocess alongside the bench — 0 findings is implied by the
+    # stamp's presence (a red audit stamps program_audit_error instead)
+    "program_audit_ms", "program_audit_error",
 }
 
 
@@ -1350,7 +1355,7 @@ _RETIRED_KEYS = ("probe_global_ms", "projected_100m_qps", "merge8_ms")
 # and a trimmed-but-parsing line beats a complete-but-unparsed one
 _TRIM_ORDER = (
     "repeats", "within_2x_warm", "escalations", "probe_flop_ratio",
-    "probe_kernel", "build_warm_s",
+    "probe_kernel", "build_warm_s", "program_audit_ms",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",
@@ -1430,7 +1435,8 @@ def _compact(row):
             continue
         if isinstance(v, str) and key not in (
             "metric", "unit", "error", "engine", "scenario",
-            "adc_engine", "scan_engine", "probe_kernel", "wire"
+            "adc_engine", "scan_engine", "probe_kernel", "wire",
+            "program_audit_error",
         ):
             continue
         if isinstance(v, list) and v and isinstance(v[0], dict):
@@ -1438,6 +1444,38 @@ def _compact(row):
         else:
             out[key] = _round_val(v)
     return out
+
+
+def _program_audit_stamp() -> dict:
+    """Run the jaxpr-level program-contract gate (ISSUE 12,
+    docs/static_analysis.md "Two tiers") in its own CPU subprocess —
+    the audit traces abstractly on the virtual 8-device CPU mesh, so it
+    measures the same programs regardless of the bench host's backend —
+    and stamp its wall time on the headline doc. A red or crashed audit
+    stamps ``program_audit_error`` (truncated) instead of hiding."""
+    import os
+    import time as _time
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    t0 = _time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "raft_tpu.analysis", "--programs"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        ms = (_time.perf_counter() - t0) * 1e3
+        if out.returncode != 0:
+            tail = (out.stdout + out.stderr)[-200:]
+            return {"program_audit_error":
+                    f"exit {out.returncode}: {tail}"[:300]}
+        return {"program_audit_ms": round(ms, 1)}
+    except Exception as e:
+        return {"program_audit_error": f"{type(e).__name__}: {e}"[:300]}
 
 
 def main():
@@ -1471,6 +1509,7 @@ def main():
         "unit": "GFLOPS",
         "spread": spread,
         "repeats": 3,
+        **_program_audit_stamp(),
         # XLA DEFAULT matmul precision: bf16-rounded operands with f32
         # accumulation — the fastest mode; the library default for f32
         # users is HIGHEST, recorded alongside (see BASELINE.md

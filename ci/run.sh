@@ -40,6 +40,20 @@ run_style() {
     fi
 }
 
+run_programs() {
+    echo "== program contracts (jaxpr-level audit) =="
+    # the second analysis tier (docs/static_analysis.md "Two tiers"):
+    # trace every fused serving program abstractly on the virtual CPU
+    # mesh — no TPU, nothing dispatches — run the five jaxpr passes
+    # (collectives, materialization, dtype flow, donation, cached-
+    # program census) and drift-check the measured contracts against
+    # ci/checks/program_contracts.json. Re-snapshot intentional changes
+    # with: python -m raft_tpu.analysis --programs --write-contracts
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m raft_tpu.analysis --programs \
+        --contracts ci/checks/program_contracts.json
+}
+
 run_install_check() {
     echo "== package import check =="
     # Installability contract: package metadata parses and the distribution
@@ -94,13 +108,14 @@ run_docs() {
 
 case "$stage" in
     style) run_style ;;
+    programs) run_programs ;;
     test) run_tests ;;
     x64) run_x64 ;;
     docs) run_docs ;;
     multihost) run_multihost_smoke ;;
-    all) run_style; run_install_check; run_docs; run_x64; \
+    all) run_style; run_programs; run_install_check; run_docs; run_x64; \
          run_multihost_smoke; run_tests ;;
-    *) echo "unknown stage: $stage (style|test|x64|docs|multihost|all)"
+    *) echo "unknown stage: $stage (style|programs|test|x64|docs|multihost|all)"
        exit 2 ;;
 esac
 echo "CI: OK"

@@ -1,8 +1,9 @@
-"""jaxlint — JAX/TPU-aware static analysis for raft_tpu.
+"""jaxlint — JAX/TPU-aware static analysis for raft_tpu, in two tiers.
 
-A multi-pass AST analyzer purpose-built for this codebase's JAX idioms
-(the reference RAFT's custom ``include_checker``-style CI checks, grown to
-cover the hazards a jit/shard_map codebase actually hits):
+**Tier 1 — the AST linter** (:mod:`raft_tpu.analysis.rules`): a
+multi-pass source analyzer purpose-built for this codebase's JAX idioms
+(the reference RAFT's custom ``include_checker``-style CI checks, grown
+to cover the hazards a jit/shard_map codebase actually hits):
 
 * ``api-compat`` — version-sensitive JAX symbols used directly instead of
   through :mod:`raft_tpu.compat` (driven by ``compat.COMPAT_TABLE``);
@@ -10,11 +11,26 @@ cover the hazards a jit/shard_map codebase actually hits):
 * ``recompile-hazard`` — dynamic static specs, mutable jit defaults,
   trace-time f-strings, mutated-closure captures;
 * ``x64-hygiene`` — 64-bit dtypes crossing the jnp boundary unguarded;
-* ``prng-discipline`` — PRNG key reuse without split/fold_in.
+* ``prng-discipline`` — PRNG key reuse without split/fold_in;
+* ``adc-gather`` / ``wide-distance-materialize`` — HBM-materialization
+  hazards on the hot scan paths;
+* ``mutation-retrace`` / ``sync-in-hot-path`` /
+  ``dcn-wide-collective`` — serving-tier recompile/sync/wire hazards.
+
+**Tier 2 — the program auditor** (:mod:`raft_tpu.analysis.program`):
+lints TRACED JAXPRS instead of source text — a jaxpr walker feeding five
+passes (collective census, materialization model, dtype flow, donation
+check, cached-program count) over the registry of fused serving
+programs, with per-program contracts snapshotted in
+``ci/checks/program_contracts.json`` and drift-checked by
+``ci/run.sh programs``.
 
 CLI: ``python -m raft_tpu.analysis [paths] [--format json] [--baseline F]
-[--write-baseline] [--rules a,b] [--list-rules]``. Per-line suppression:
-``# jaxlint: disable=<rule>[,<rule>]``. See docs/static_analysis.md.
+[--write-baseline] [--rules a,b] [--list-rules]`` for the source tier;
+``--programs [--contracts F] [--write-contracts] [--list-programs]`` for
+the program tier. Per-line suppression:
+``# jaxlint: disable=<rule>[,<rule>]``. See docs/static_analysis.md
+("Two tiers: source lint vs program audit").
 """
 
 from raft_tpu.analysis.engine import (

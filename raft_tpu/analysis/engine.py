@@ -247,7 +247,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset to run")
     ap.add_argument("--list-rules", action="store_true")
+    # -- the program tier (jaxpr-level audit; docs/static_analysis.md
+    # "Two tiers"). Implemented in raft_tpu.analysis.program and only
+    # imported when requested: the AST tier must keep running without
+    # paying (or requiring) a jax import.
+    ap.add_argument("--programs", action="store_true",
+                    help="audit traced serving programs against "
+                         "ci/checks/program_contracts.json instead of "
+                         "linting source files")
+    ap.add_argument("--contracts", type=Path, default=None,
+                    help="program contracts JSON (default: "
+                         "ci/checks/program_contracts.json)")
+    ap.add_argument("--write-contracts", action="store_true",
+                    help="re-snapshot the program contracts (pass "
+                         "findings still gate the exit code)")
+    ap.add_argument("--list-programs", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.programs or args.list_programs:
+        from raft_tpu.analysis.program.contracts import main_programs
+
+        return main_programs(args)
+    if args.write_contracts:
+        print("jaxlint: --write-contracts requires --programs",
+              file=sys.stderr)
+        return 2
 
     if args.list_rules:
         for r in ALL_RULES:

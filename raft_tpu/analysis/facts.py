@@ -61,6 +61,11 @@ class ModuleFacts:
         self.tree = tree
         # local name -> dotted origin ("jnp" -> "jax.numpy")
         self.aliases: Dict[str, str] = {}
+        # name -> the partial(<transform>, ...) call it was assigned
+        # from: `grouped_jit = partial(jax.jit, static_argnames=...)`
+        # used as `@grouped_jit` (or call-form) later — the
+        # decorator-factory idiom. The factory call carries the statics.
+        self.transform_factories: Dict[str, ast.Call] = {}
         self.parent: Dict[ast.AST, ast.AST] = {}
         self.functions_by_name: Dict[str, List[ast.FunctionDef]] = {}
         self.traced: List[FunctionNode] = []
@@ -113,6 +118,28 @@ class ModuleFacts:
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.functions_by_name.setdefault(node.name, []).append(node)
 
+        # pass 1.5 — transforms bound to names by ASSIGNMENT, before use:
+        # `jit_k = partial(jax.jit, static_argnames=("k",))` (a decorator
+        # factory carrying statics) and `jit2 = jax.jit` (a plain
+        # rebinding, folded into the alias map so dotted() resolves it).
+        # Runs after the import pass so alias resolution is complete, and
+        # before the decorator/call pass so `@jit_k` marks its function.
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            tgt = node.targets[0].id
+            val = node.value
+            if isinstance(val, ast.Call):
+                ct = self.dotted(val.func)
+                if ct and ct.split(".")[-1] == "partial" and val.args \
+                        and self._transform_tail(val.args[0]):
+                    self.transform_factories[tgt] = val
+            else:
+                d = self.dotted(val)
+                if d is not None and self._transform_tail(val):
+                    self.aliases[tgt] = d
+
         for node in ast.walk(self.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_decorators(node)
@@ -144,7 +171,23 @@ class ModuleFacts:
                             static.add(names[el.value])
         return static
 
-    def _transform_tail(self, node: ast.AST) -> Optional[str]:
+    def _factory_call(self, node: ast.AST) -> Optional[ast.Call]:
+        """The ``partial(<transform>, ...)`` call a plain Name was
+        assigned from, when this node is such a name (the decorator-
+        factory idiom — its statics live on the factory call)."""
+        if isinstance(node, ast.Name):
+            return self.transform_factories.get(node.id)
+        return None
+
+    def _transform_tail(self, node: ast.AST,
+                        _depth: int = 0) -> Optional[str]:
+        fac = self._factory_call(node)
+        if fac is not None:
+            # depth-bounded: `j = partial(j, ...)` rebinding would
+            # otherwise cycle through its own factory entry
+            if _depth > 8:
+                return None
+            return self._transform_tail(fac.args[0], _depth + 1)
         d = self.dotted(node)
         if d is None:
             return None
@@ -170,6 +213,12 @@ class ModuleFacts:
                         continue
             if tail is not None:
                 static = self._static_from_call(call, fn) if call else set()
+                # statics declared on the assigned factory — @jit_k with
+                # jit_k = partial(jax.jit, static_argnames=...) — carry
+                # to every function the factory decorates
+                fac = self._factory_call(target)
+                if fac is not None:
+                    static |= self._static_from_call(fac, fn)
                 self._mark_traced(fn, static)
 
     def _check_transform_call(self, call: ast.Call) -> None:
@@ -182,6 +231,7 @@ class ModuleFacts:
                     is_partial_jit = True
         if tail is None and not is_partial_jit:
             return
+        fac = self._factory_call(call.func)
         args = call.args[1:] if is_partial_jit else call.args
         static: Set[str] = set()
         for arg in args:
@@ -194,6 +244,9 @@ class ModuleFacts:
                     fn = defs[-1]
             if fn is not None:
                 static = self._static_from_call(call, fn)
+                if fac is not None:
+                    # jit_k(body): the factory's statics apply too
+                    static |= self._static_from_call(fac, fn)
                 self._mark_traced(fn, static)
 
     # -- traced-body queries -------------------------------------------------

@@ -1,0 +1,78 @@
+"""The program auditor — jaxlint's second tier, over traced jaxprs.
+
+The AST tier (:mod:`raft_tpu.analysis.rules`) lints source text; this
+package lints the PROGRAMS the source traces into: a jaxpr walker
+(:mod:`~raft_tpu.analysis.program.walker`) recursing through
+pjit/shard_map/scan/cond sub-jaxprs feeds five passes
+(:mod:`~raft_tpu.analysis.program.passes`) —
+
+* ``collective-census`` — axes + payload bytes of every collective; no
+  inner×outer wide collective, the DCN stage stays on the compressed
+  wire;
+* ``materialization-model`` — peak intermediate bytes; no
+  (qcap, max_list) f32 tile materialized in a scan path;
+* ``dtype-flow`` — convert_element_type census; no 64-bit dtypes,
+  bf16→f32 upcasts within the sanctioned tails;
+* ``donation-check`` — serving dispatches actually donate their query
+  buffers in the lowering;
+* ``program-count`` — the zero-retrace contract as a cached-program
+  census across health/failover/mutation value flips
+
+— over a registry of audited entry points
+(:mod:`~raft_tpu.analysis.program.registry`), with each program's
+measured contract snapshotted into ``ci/checks/program_contracts.json``
+and drift-checked by CI
+(:mod:`~raft_tpu.analysis.program.contracts`). CLI:
+``python -m raft_tpu.analysis --programs``; per-index:
+``index.warmup(..., audit=True)``. Docs: docs/static_analysis.md
+"Two tiers".
+
+Everything here traces abstractly on CPU (``JAX_PLATFORMS=cpu``) — no
+TPU, no device dispatch; jax imports stay inside functions so the AST
+tier never pays them.
+"""
+
+from raft_tpu.analysis.program.passes import (
+    ALL_PASSES,
+    ProgramRecord,
+    run_passes,
+)
+from raft_tpu.analysis.program.walker import (
+    EqnSite,
+    aval_bytes,
+    collective_axes,
+    out_bytes,
+    sub_jaxprs,
+    walk_jaxpr,
+)
+
+__all__ = [
+    "ALL_PASSES",
+    "EqnSite",
+    "ProgramRecord",
+    "audit_warmed",
+    "aval_bytes",
+    "collective_axes",
+    "out_bytes",
+    "run_passes",
+    "sub_jaxprs",
+    "walk_jaxpr",
+]
+
+
+def audit_warmed(record: "ProgramRecord") -> None:
+    """The ``warmup(audit=True)`` hook: run the jaxpr passes over one
+    freshly-traced serving program and raise
+    :class:`~raft_tpu.errors.RaftError` listing the findings when the
+    program violates its tier's invariants (wide collectives, scan-path
+    f32 tiles, 64-bit dtypes, missing donation). Contract drift is CI's
+    job (``--programs``); this hook is the in-process spot check a
+    serving deployment runs once at warmup."""
+    from raft_tpu import errors
+
+    _, findings = run_passes(record)
+    errors.expects(
+        not findings,
+        "program audit failed for %s:\n%s",
+        record.name, "\n".join(f.render() for f in findings),
+    )

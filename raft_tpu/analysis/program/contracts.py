@@ -1,0 +1,192 @@
+"""Contract snapshot + drift check — the program tier's baseline file.
+
+``ci/checks/program_contracts.json`` pins one contract per audited entry
+point (:mod:`registry`): its collective census, materialization model,
+dtype-cast census, donated buffers, and cached-program count. The
+discipline is ``jaxlint_baseline.json``'s, applied to programs:
+
+* CI audits the LIVE programs and fails on any pass finding — the hard
+  gate, no snapshot consulted;
+* then it diffs the live contracts against the committed snapshot and
+  fails on ANY drift, in either direction: a changed field is a silent
+  behavior change (e.g. the DCN merge regressed to an f32 wire — the
+  bytes and dtypes move, the results do not), a missing live program is
+  a stale snapshot entry, and an unsnapshotted live program is a new
+  serving op landing unpinned;
+* an INTENTIONAL change is re-snapshotted with
+  ``python -m raft_tpu.analysis --programs --write-contracts`` and the
+  diff reviewed like any baseline shrink.
+
+``--format json`` emits the same schema as the jaxlint CLI (findings /
+suppressed / baselined / checked_files / rules), so the one consumer
+script parses both tiers.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.analysis.engine import Finding
+
+DEFAULT_CONTRACTS = Path("ci/checks/program_contracts.json")
+
+_COMMENT = (
+    "program contracts — jaxpr-level audit snapshots per fused serving "
+    "program (raft_tpu.analysis.program); re-snapshot intentional "
+    "changes with: python -m raft_tpu.analysis --programs "
+    "--write-contracts"
+)
+
+
+def audit_programs(*, count: bool = True, names=None
+                   ) -> Tuple[Dict[str, dict], List[Finding]]:
+    """Trace every registry entry and run the passes: returns
+    ``(live contracts by name, pass findings)``."""
+    from raft_tpu.analysis.program.passes import run_passes
+    from raft_tpu.analysis.program.registry import audit_all
+
+    contracts: Dict[str, dict] = {}
+    findings: List[Finding] = []
+    for name, rec in audit_all(count=count, names=names).items():
+        contract, fs = run_passes(rec)
+        contracts[name] = contract
+        findings.extend(fs)
+    return contracts, findings
+
+
+def load_contracts(path: Path) -> Dict[str, dict]:
+    data = json.loads(Path(path).read_text())
+    return data.get("programs", {})
+
+
+def write_contracts(path: Path, contracts: Dict[str, dict]) -> None:
+    payload = {
+        "comment": _COMMENT,
+        "version": 1,
+        "programs": {k: contracts[k] for k in sorted(contracts)},
+    }
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True)
+                          + "\n")
+
+
+def _diff_lines(committed, live, prefix="") -> List[str]:
+    """Human-readable leaf diffs between two contract fragments."""
+    out: List[str] = []
+    if isinstance(committed, dict) and isinstance(live, dict):
+        for k in sorted(set(committed) | set(live)):
+            out.extend(_diff_lines(
+                committed.get(k, "<absent>"), live.get(k, "<absent>"),
+                f"{prefix}{k}.",
+            ))
+        return out
+    if committed != live:
+        out.append(f"{prefix.rstrip('.')}: snapshot {committed!r} "
+                   f"!= live {live!r}")
+    return out
+
+
+def check_drift(live: Dict[str, dict], committed: Dict[str, dict]
+                ) -> List[Finding]:
+    """Bidirectional drift findings (rule ``program-contract``)."""
+    findings: List[Finding] = []
+
+    def f(name: str, message: str) -> Finding:
+        return Finding(
+            path=f"<program:{name}>", line=0, col=0,
+            rule="program-contract", message=message,
+        )
+
+    for name in sorted(set(committed) - set(live)):
+        findings.append(f(
+            name,
+            "snapshotted program no longer exists in the registry — a "
+            "stale contract entry silently narrows the gate; remove it "
+            "(--write-contracts) or restore the entry point",
+        ))
+    for name in sorted(set(live) - set(committed)):
+        findings.append(f(
+            name,
+            "live program has no committed contract — a new serving op "
+            "must land pinned; snapshot it with --write-contracts and "
+            "review the diff",
+        ))
+    for name in sorted(set(live) & set(committed)):
+        diffs = _diff_lines(committed[name], live[name])
+        if diffs:
+            findings.append(f(
+                name,
+                "contract drift vs the committed snapshot ("
+                + "; ".join(diffs[:6])
+                + (f"; +{len(diffs) - 6} more" if len(diffs) > 6 else "")
+                + ") — if intentional, re-snapshot with "
+                "--write-contracts and review the diff",
+            ))
+    return findings
+
+
+def run_program_audit(contracts_path: Optional[Path] = None, *,
+                      write: bool = False, count: bool = True):
+    """The CLI core: audit, then drift-check (or re-snapshot).
+    Returns ``(findings, checked_count, live_contracts)``. Re-snapshot
+    (``write=True``) rewrites the file but still RETURNS the pass
+    findings: the hard gate holds regardless of any snapshot, so a
+    violating program cannot be laundered into a green baseline by
+    re-snapshotting it."""
+    path = Path(contracts_path or DEFAULT_CONTRACTS)
+    live, findings = audit_programs(count=count)
+    if write:
+        write_contracts(path, live)
+        return findings, len(live), live
+    committed = load_contracts(path) if path.exists() else {}
+    findings = findings + check_drift(live, committed)
+    return findings, len(live), live
+
+
+def main_programs(args) -> int:
+    """``python -m raft_tpu.analysis --programs`` — dispatched from
+    :func:`raft_tpu.analysis.engine.main` after flag parsing."""
+    import sys
+
+    from raft_tpu.analysis.program.passes import ALL_PASSES
+    from raft_tpu.analysis.program.registry import SPECS
+
+    if args.list_programs:
+        for s in SPECS:
+            print(f"{s.name}: {s.description}")
+        return 0
+
+    findings, checked, _ = run_program_audit(
+        args.contracts, write=args.write_contracts,
+    )
+    rule_names = [p.name for p in ALL_PASSES] + ["program-contract"]
+    if args.write_contracts:
+        # the snapshot is written either way, but pass findings are the
+        # hard gate: a violating program must fail its own re-snapshot
+        # run, not hide inside a freshly-green baseline
+        for f in findings:
+            print(f.render())
+        print(f"program-audit: wrote {checked} contract(s) to "
+              f"{args.contracts or DEFAULT_CONTRACTS}"
+              + (f" — {len(findings)} pass finding(s) still gate"
+                 if findings else ""))
+        return 1 if findings else 0
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": 0,
+            "baselined": 0,
+            "checked_files": checked,
+            "rules": rule_names,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"program-audit: checked {checked} programs — "
+            f"{len(findings)} finding(s)"
+        )
+    if findings:
+        print("program-audit: FAIL", file=sys.stderr)
+    return 1 if findings else 0

@@ -1,0 +1,328 @@
+"""The program-auditor passes — jaxpr-level twins of the source rules.
+
+Each pass consumes a :class:`ProgramRecord` (one traced serving program
+plus the audit metadata its registry entry declared) and contributes
+
+* **contract fields** — the measured facts that get snapshotted into
+  ``ci/checks/program_contracts.json`` and drift-checked by CI (the
+  baseline discipline of ``jaxlint_baseline.json``, applied to programs);
+* **findings** — hard failures that gate CI regardless of any snapshot
+  (:class:`raft_tpu.analysis.engine.Finding`, rendered like a lint hit
+  with the pseudo-path ``<program:NAME>``).
+
+The five passes (ISSUE 12):
+
+``collective-census``
+    Every named-axis collective with its axis names and per-chip payload
+    bytes. Findings: a collective naming a DCN axis *together with* an
+    inner axis (the program-level twin of the AST
+    ``dcn-wide-collective`` rule — an inner pre-reduction exists by
+    construction), and an f32 ``all_gather`` over the DCN axis in a
+    program whose contract declares the compressed bf16 wire (the
+    rerank-tail ``psum`` stays sanctioned: exact-recovery is f32 by
+    design, docs/multihost.md).
+
+``materialization-model``
+    Peak single-equation output bytes (the largest XLA-visible
+    intermediate) and a census of wide f32 distance tiles inside
+    scan/while bodies — an f32 output whose trailing dims are exactly
+    ``(qcap, max_list)`` is the materialized grouped-scan tile both
+    Pallas engines exist to avoid (twin of
+    ``wide-distance-materialize``). Pallas kernel jaxprs are skipped:
+    their intermediates are VMEM refs, which is the point.
+
+``dtype-flow``
+    A census of ``convert_element_type`` edges (``"bfloat16->float32"``
+    counts and friends). Findings: any 64-bit dtype in the program
+    (serving programs are <= 32-bit by contract; the x64 harness runs in
+    its own process), and — when the registry entry budgets it — more
+    bf16→f32 upcasts than the sanctioned rerank/psum tails account for.
+
+``donation-check``
+    The donated input buffers of the LOWERED program (from
+    ``Lowered.args_info``, i.e. what the runtime will actually alias) —
+    a serving entry prepared with ``donate_queries=True`` whose lowering
+    donates nothing silently doubles the query batch's HBM residency.
+
+``program-count``
+    The cached-program census across a runtime-value flip matrix
+    (health up/down, failover routes, mutation states): the zero-retrace
+    contract says every flip must resolve to the SAME prepared program
+    (same compiled-function identity, same operand avals). A census > 1
+    means some static was derived from a runtime value — the
+    ``mutation-retrace`` hazard, observed at the program level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from raft_tpu.analysis.engine import Finding
+from raft_tpu.analysis.program.walker import (
+    aval_bytes,
+    collective_axes,
+    out_bytes,
+    walk_jaxpr,
+)
+
+# named-axis collectives that move payload bytes (axis_index moves none)
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "ppermute", "psum_scatter", "reduce_scatter", "pgather",
+})
+
+_64BIT = frozenset({"float64", "int64", "uint64", "complex128"})
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    """One audited serving program.
+
+    ``meta`` keys the passes read (all optional unless noted):
+
+    * ``qcap`` / ``max_list`` — the grouped-scan tile dims the
+      materialization model matches against;
+    * ``allow_wide_tile`` — the entry intentionally materializes the
+      tile (the legacy XLA engines, kept as bit-stable fallbacks): the
+      census still counts it into the contract, but no finding fires;
+    * ``dcn_axes`` — mesh axis names that cross host boundaries
+      (from :func:`raft_tpu.comms.multihost.hier_axes`);
+    * ``dcn_wire`` — ``"bf16"`` pins the compressed wire: an f32
+      all_gather over a DCN axis becomes a finding;
+    * ``expect_donated_queries`` — the entry was prepared as a serving
+      dispatch (``donate_queries=True``): a lowering that donates no
+      buffer becomes a finding;
+    * ``max_bf16_to_f32`` — optional upcast budget for dtype-flow.
+    """
+
+    name: str
+    jaxpr: object                              # ClosedJaxpr
+    meta: Dict = dataclasses.field(default_factory=dict)
+    donated: Optional[List[int]] = None        # flat donated leaf indices
+    program_count: Optional[int] = None        # flip-matrix census
+
+    def finding(self, rule: str, message: str) -> Finding:
+        return Finding(
+            path=f"<program:{self.name}>", line=0, col=0,
+            rule=rule, message=message,
+        )
+
+
+# -- passes ------------------------------------------------------------------
+
+
+def collective_census(rec: ProgramRecord):
+    census: Dict[Tuple, int] = {}
+    findings: List[Finding] = []
+    dcn_axes = set(rec.meta.get("dcn_axes", ()))
+    dcn_wire_dtypes = set()
+    for site in walk_jaxpr(rec.jaxpr):
+        if site.prim not in _COLLECTIVE_PRIMS:
+            continue
+        axes = collective_axes(site.eqn)
+        payload = sum(aval_bytes(v.aval) for v in site.eqn.invars)
+        dtypes = sorted({
+            str(getattr(v.aval, "dtype", "?")) for v in site.eqn.invars
+        })
+        key = (site.prim, axes, payload, tuple(dtypes))
+        census[key] = census.get(key, 0) + 1
+        hits_dcn = dcn_axes and (set(axes) & dcn_axes)
+        if hits_dcn and len(axes) > 1:
+            findings.append(rec.finding(
+                "collective-census",
+                f"{site.prim} over axes {list(axes)} ships full per-chip "
+                f"payloads ({payload} B) across the host boundary at "
+                "deployment width — pre-reduce over the inner axis first "
+                "(hierarchical_merge_select_k / hierarchical_allreduce, "
+                "docs/multihost.md); the AST twin is "
+                "dcn-wide-collective",
+            ))
+        if hits_dcn and site.prim == "all_gather":
+            dcn_wire_dtypes.update(dtypes)
+            if rec.meta.get("dcn_wire") == "bf16" and "float32" in dtypes:
+                findings.append(rec.finding(
+                    "collective-census",
+                    f"all_gather over DCN axes {list(axes)} carries "
+                    "float32 payload but this program's contract pins "
+                    "the compressed bf16+id wire (6 B/candidate) — the "
+                    "DCN stage regressed to the uncompressed format "
+                    "(docs/multihost.md \"Byte accounting\")",
+                ))
+    contract = {
+        "collectives": sorted(
+            (
+                {
+                    "prim": prim, "axes": list(axes), "bytes": payload,
+                    "dtypes": list(dtypes), "count": n,
+                }
+                for (prim, axes, payload, dtypes), n in census.items()
+            ),
+            key=lambda e: (e["prim"], e["axes"], e["bytes"], e["dtypes"]),
+        ),
+        "dcn_wire_dtypes": sorted(dcn_wire_dtypes),
+    }
+    return contract, findings
+
+
+def materialization_model(rec: ProgramRecord):
+    peak = 0
+    wide = 0
+    findings: List[Finding] = []
+    qcap = rec.meta.get("qcap")
+    max_list = rec.meta.get("max_list")
+    for site in walk_jaxpr(rec.jaxpr):
+        if site.in_kernel:
+            continue                 # VMEM refs, not HBM materialization
+        b = out_bytes(site.eqn)
+        peak = max(peak, b)
+        if not site.in_scan or qcap is None or max_list is None:
+            continue
+        for v in site.eqn.outvars:
+            aval = v.aval
+            shape = getattr(aval, "shape", ())
+            dtype = str(getattr(aval, "dtype", ""))
+            if (
+                dtype == "float32" and len(shape) >= 3
+                and tuple(shape[-2:]) == (qcap, max_list)
+            ):
+                wide += 1
+                if not rec.meta.get("allow_wide_tile"):
+                    findings.append(rec.finding(
+                        "materialization-model",
+                        f"{site.prim} materializes a "
+                        f"{tuple(shape)} float32 tile inside a "
+                        f"{'/'.join(site.path) or 'top-level'} scan body "
+                        f"— the (qcap={qcap}, max_list={max_list}) "
+                        "grouped distance tile round-trips HBM every "
+                        "iteration; route the scan through the Pallas "
+                        "sub-chunk-min engines (docs/ivf_scale.md); the "
+                        "AST twin is wide-distance-materialize",
+                    ))
+    return {"peak_eqn_bytes": peak, "scan_wide_f32_tiles": wide}, findings
+
+
+def dtype_flow(rec: ProgramRecord):
+    # kernels ARE walked here: an in-kernel 64-bit dtype or cast is as
+    # real as one outside (the kernel's working set), unlike the
+    # materialization model where VMEM refs are not HBM intermediates
+    casts: Dict[str, int] = {}
+    wide64 = set()
+    findings: List[Finding] = []
+    for site in walk_jaxpr(rec.jaxpr):
+        for v in list(site.eqn.invars) + list(site.eqn.outvars):
+            d = str(getattr(getattr(v, "aval", None), "dtype", ""))
+            if d in _64BIT:
+                wide64.add(d)
+        if site.prim != "convert_element_type":
+            continue
+        src = str(getattr(site.eqn.invars[0].aval, "dtype", "?"))
+        dst = str(site.eqn.params.get("new_dtype", "?"))
+        key = f"{src}->{dst}"
+        casts[key] = casts.get(key, 0) + 1
+    for d in sorted(wide64):
+        findings.append(rec.finding(
+            "dtype-flow",
+            f"{d} value inside a serving program — serving programs are "
+            "<= 32-bit by contract (the x64 pass runs in its own "
+            "process, ci/run.sh x64); an unguarded wide dtype doubles "
+            "operand bytes on every path it touches",
+        ))
+    budget = rec.meta.get("max_bf16_to_f32")
+    up = casts.get("bfloat16->float32", 0)
+    if budget is not None and up > budget:
+        findings.append(rec.finding(
+            "dtype-flow",
+            f"{up} bfloat16->float32 upcasts but the contract sanctions "
+            f"at most {budget} (the exact rerank / psum tails) — a bf16 "
+            "intermediate is being widened outside the sanctioned tails",
+        ))
+    return {
+        "casts": dict(sorted(casts.items())),
+        "dtypes_64bit": sorted(wide64),
+    }, findings
+
+
+def donation_check(rec: ProgramRecord):
+    findings: List[Finding] = []
+    donated = rec.donated
+    if rec.meta.get("expect_donated_queries") and not donated:
+        findings.append(rec.finding(
+            "donation-check",
+            "prepared as a serving dispatch (donate_queries=True) but "
+            "the lowered program donates NO input buffer — the query "
+            "batch's memory is never aliased to the outputs, doubling "
+            "its HBM residency per in-flight dispatch (docs/serving.md)",
+        ))
+    return {"donated": donated}, findings
+
+
+def program_count(rec: ProgramRecord):
+    findings: List[Finding] = []
+    n = rec.program_count
+    if n is not None and n > 1:
+        findings.append(rec.finding(
+            "program-count",
+            f"{n} distinct programs across the runtime-value flip matrix "
+            "(health / failover / mutation) — the zero-retrace contract "
+            "requires ONE: some static is derived from a runtime value "
+            "(the mutation-retrace hazard at program level, "
+            "docs/robustness.md)",
+        ))
+    return {"program_count": n}, findings
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditPass:
+    name: str
+    description: str
+    run: Callable
+
+
+ALL_PASSES: List[AuditPass] = [
+    AuditPass(
+        "collective-census",
+        "every named-axis collective with axes + payload bytes; flags "
+        "inner×outer wide collectives and an uncompressed DCN wire",
+        collective_census,
+    ),
+    AuditPass(
+        "materialization-model",
+        "peak per-equation intermediate bytes; flags (qcap, max_list) "
+        "f32 distance tiles materialized inside scan bodies",
+        materialization_model,
+    ),
+    AuditPass(
+        "dtype-flow",
+        "convert_element_type census; flags 64-bit dtypes and "
+        "over-budget bf16→f32 upcasts",
+        dtype_flow,
+    ),
+    AuditPass(
+        "donation-check",
+        "donated input buffers of the lowered program; flags serving "
+        "dispatches whose queries are not actually donated",
+        donation_check,
+    ),
+    AuditPass(
+        "program-count",
+        "cached-program census across health/failover/mutation value "
+        "flips; flags any retrace (> 1 program)",
+        program_count,
+    ),
+]
+
+
+def run_passes(rec: ProgramRecord):
+    """Run every pass over one record; returns (contract, findings)."""
+    contract: Dict = {"meta": {
+        k: rec.meta[k]
+        for k in sorted(rec.meta)
+        if isinstance(rec.meta[k], (int, float, str, bool, type(None)))
+    }}
+    findings: List[Finding] = []
+    for p in ALL_PASSES:
+        frag, fs = p.run(rec)
+        contract.update(frag)
+        findings.extend(fs)
+    return contract, findings

@@ -1,0 +1,558 @@
+"""The audited entry points — one :class:`ProgramRecord` per fused
+serving program whose contract CI pins.
+
+Every record is produced by tracing the REAL entry point (the serving
+wrappers' own ``_prepare_*`` front halves, or the jitted engine bodies
+with the wrappers' own resolved statics) over a deterministic toy world
+built here: 768×16 blobs-free Gaussian data, seeded builds, an 8-device
+CPU mesh — small enough that the whole registry traces in well under a
+minute with ``JAX_PLATFORMS=cpu``, large enough that every staging level
+(pjit → shard_map → scan → pallas_call) appears in the jaxprs. Tracing is
+abstract: nothing here needs a TPU, and only the cached-program census
+executes host-side Python (it compares PREPARED programs, never runs
+them).
+
+The contract snapshot pins the audit at THIS geometry. That is the
+point: the hazards the passes catch — a wide collective, a materialized
+(qcap, max_list) tile, a dropped donation, a value-derived static — are
+*shape-pattern* regressions visible at any scale, so a toy-geometry trace
+catches them at CI speed while the bench rounds keep measuring the real
+ones.
+
+Kernel-mode entries trace with ``pallas_interpret=True`` — the identical
+program modulo the interpret flag, which changes how the ``pallas_call``
+executes, not what the surrounding jaxpr materializes, ships, or donates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from raft_tpu.analysis.program.passes import ProgramRecord
+
+_NQ, _D, _N, _K, _P, _QCAP, _LISTS = 16, 16, 768, 4, 4, 8, 16
+
+
+def _leaf_key(args) -> tuple:
+    """Shape/dtype signature of a prepared operand pytree — together
+    with the prepared function's identity this keys the compiled
+    program, so equal keys == zero retraces."""
+    import jax
+
+    return tuple(
+        (tuple(a.shape), str(a.dtype))
+        for a in jax.tree_util.tree_leaves(args)
+        if hasattr(a, "shape")
+    )
+
+
+def flip_census(prepare: Callable[..., tuple], flips: List[dict]) -> int:
+    """The ``program-count`` census: prepare (never dispatch) the serving
+    program under every runtime-value flip and count distinct
+    (program identity, operand avals) pairs. The zero-retrace contract
+    says this is 1 — a 2 means some static was derived from a runtime
+    value and a health/failover/mutation flip would recompile."""
+    keys = set()
+    for kw in flips:
+        fn, args, _ = prepare(**kw)
+        keys.add((id(fn), _leaf_key(args)))
+    return len(keys)
+
+
+def donated_leaves(traced) -> List[int]:
+    """Flat indices of donated input leaves from a ``jax.stages.Traced``
+    (what the runtime will actually alias, not what the caller asked)."""
+    import jax
+
+    info = traced.lower().args_info
+    return [
+        i for i, a in enumerate(jax.tree_util.tree_leaves(info))
+        if getattr(a, "donated", False)
+    ]
+
+
+def record_from_traced(name: str, traced, meta: dict, *,
+                       program_count: Optional[int] = None,
+                       donation: bool = True) -> ProgramRecord:
+    return ProgramRecord(
+        name=name,
+        jaxpr=traced.jaxpr,
+        meta=meta,
+        donated=donated_leaves(traced) if donation else None,
+        program_count=program_count,
+    )
+
+
+# -- the toy world -----------------------------------------------------------
+
+
+class _World:
+    """Deterministic toy indexes + meshes, built lazily and cached for
+    the process (the audit runs once per CI invocation)."""
+
+    _inst = None
+
+    @classmethod
+    def get(cls) -> "_World":
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+    def __init__(self):
+        rng = np.random.default_rng(7)
+        self.x = rng.standard_normal((_N, _D)).astype(np.float32)
+        self.q = rng.standard_normal((_NQ, _D)).astype(np.float32)
+        self._cache: Dict[str, object] = {}
+
+    def _memo(self, key: str, make):
+        if key not in self._cache:
+            self._cache[key] = make()
+        return self._cache[key]
+
+    @property
+    def flat_index(self):
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+
+        return self._memo("flat", lambda: ivf_flat_build(
+            self.x, IVFFlatParams(n_lists=_LISTS, kmeans_n_iters=3, seed=0)
+        ))
+
+    @property
+    def pq_index(self):
+        from raft_tpu.spatial.ann import IVFPQParams, ivf_pq_build
+
+        return self._memo("pq", lambda: ivf_pq_build(
+            self.x, IVFPQParams(
+                n_lists=_LISTS, pq_dim=4, pq_bits=4, kmeans_n_iters=3,
+                pq_kmeans_n_iters=3, seed=0,
+            )
+        ))
+
+    @property
+    def sq_index(self):
+        from raft_tpu.spatial.ann import IVFSQParams, ivf_sq_build
+
+        return self._memo("sq", lambda: ivf_sq_build(
+            self.x, IVFSQParams(n_lists=_LISTS, kmeans_n_iters=3, seed=0)
+        ))
+
+    @property
+    def comms(self):
+        import jax
+
+        from raft_tpu.comms import build_comms
+
+        return self._memo("comms", lambda: build_comms(jax.devices()[:8]))
+
+    @property
+    def hier_comms(self):
+        import jax
+
+        from raft_tpu.comms import build_comms_hierarchical
+
+        return self._memo("hier", lambda: build_comms_hierarchical(
+            jax.devices()[:8], mesh_shape=(2, 4)
+        ))
+
+    @property
+    def mnmg_pq(self):
+        from raft_tpu.comms import mnmg_ivf_pq_build
+        from raft_tpu.spatial.ann import IVFPQParams
+
+        return self._memo("mnmg_pq", lambda: mnmg_ivf_pq_build(
+            self.comms, self.x, IVFPQParams(
+                n_lists=_LISTS, pq_dim=4, pq_bits=4, kmeans_n_iters=3,
+                pq_kmeans_n_iters=3, seed=0,
+            )
+        ))
+
+    @property
+    def mnmg_flat(self):
+        from raft_tpu.comms import mnmg_ivf_flat_build
+        from raft_tpu.spatial.ann import IVFFlatParams
+
+        return self._memo("mnmg_flat", lambda: mnmg_ivf_flat_build(
+            self.comms, self.x,
+            IVFFlatParams(n_lists=_LISTS, kmeans_n_iters=3, seed=0),
+            metric="sqeuclidean",
+        ))
+
+    def mutation_state(self, index, salt: int = 0):
+        """A placed mutation state for ``index``; ``salt`` perturbs
+        VALUES only (one tombstone flipped) — the flip-census input."""
+        from raft_tpu.comms.mnmg_mutation import wrap_mnmg_mutable
+
+        m = self._memo(
+            f"mut{id(index)}",
+            lambda: wrap_mnmg_mutable(self.comms, index, delta_cap=2),
+        )
+        if not salt:
+            return m.state
+        import dataclasses as dc
+
+        import jax.numpy as jnp
+
+        rm = np.asarray(m.state.row_mask).copy()
+        rm[0, salt % rm.shape[1]] = 0
+        return dc.replace(m.state, row_mask=jnp.asarray(rm))
+
+
+# -- single-chip engine traces (shared with warmup(audit=True)) --------------
+
+
+def trace_flat_grouped(index, nq: int, k: int, n_probes: int, qcap: int,
+                       *, list_block: int = 8, use_pallas: bool = False,
+                       rerank_ratio: float = 4.0, dequant=None,
+                       name: str = "ivf_flat_grouped",
+                       extra_meta: Optional[dict] = None) -> ProgramRecord:
+    """Trace the ONE grouped scan body (flat / SQ mode) with the serving
+    wrapper's statics — the audit twin of ``ivf_flat_search_grouped`` /
+    ``ivf_sq_search_grouped`` at an explicit serving qcap."""
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.ivf_flat import _grouped_impl
+
+    q0 = jnp.zeros((nq, index.centroids.shape[1]), jnp.float32)
+    # the wrapper's own clamp — audited statics == served statics
+    list_block = max(1, min(list_block, index.storage.list_index.shape[0]))
+    traced = _grouped_impl.trace(
+        index, q0, k, n_probes, qcap, list_block,
+        use_pallas=use_pallas, pallas_interpret=True,
+        rerank_ratio=float(rerank_ratio), dequant=dequant,
+    )
+    meta = {
+        "nq": nq, "k": k, "n_probes": n_probes, "qcap": qcap,
+        "max_list": int(index.storage.max_list),
+        "engine": "pallas" if use_pallas else "xla",
+        "allow_wide_tile": not use_pallas,
+    }
+    meta.update(extra_meta or {})
+    return record_from_traced(name, traced, meta)
+
+
+def trace_pq_grouped(index, nq: int, k: int, n_probes: int, qcap: int,
+                     *, list_block: int = 8, refine_ratio: float = 2.0,
+                     exact_selection: bool = True,
+                     approx_recall_target: float = 0.95,
+                     use_pallas: bool = False,
+                     name: str = "ivf_pq_grouped",
+                     extra_meta: Optional[dict] = None) -> ProgramRecord:
+    """Trace the grouped ADC body with the serving wrapper's statics —
+    the audit twin of ``ivf_pq_search_grouped``."""
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.ivf_pq import _pq_grouped_impl
+
+    q0 = jnp.zeros((nq, index.centroids.shape[1]), jnp.float32)
+    # the wrapper's own clamp — audited statics == served statics
+    list_block = max(1, min(list_block, index.centroids.shape[0]))
+    traced = _pq_grouped_impl.trace(
+        index, q0, k, n_probes, qcap, list_block, float(refine_ratio),
+        None, None, exact_selection, approx_recall_target,
+        use_pallas=use_pallas, pallas_interpret=True,
+    )
+    meta = {
+        "nq": nq, "k": k, "n_probes": n_probes, "qcap": qcap,
+        "max_list": int(index.storage.max_list),
+        "engine": "pallas" if use_pallas else "xla",
+        "allow_wide_tile": not use_pallas,
+    }
+    meta.update(extra_meta or {})
+    return record_from_traced(name, traced, meta)
+
+
+def _trace_fn(fn, *args, **kw):
+    """``Traced`` for jitted fns (their own ``.trace``) or a make_jaxpr
+    shim for plain functions (donation then unavailable). The shim
+    traces over the FIRST argument only (the query batch) and closes
+    over the rest, so Python-int statics stay concrete — exactly how
+    the fused bodies call these helpers."""
+    import jax
+
+    if hasattr(fn, "trace"):
+        return fn.trace(*args, **kw)
+
+    class _Shim:
+        jaxpr = jax.make_jaxpr(
+            lambda q: fn(q, *args[1:], **kw)
+        )(args[0])
+
+    return _Shim()
+
+
+# -- the registry ------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    name: str
+    description: str
+    build: Callable[[_World, bool], ProgramRecord]
+
+
+def _spec(name, description):
+    def deco(f):
+        SPECS.append(ProgramSpec(name, description, f))
+        return f
+    return deco
+
+
+SPECS: List[ProgramSpec] = []
+
+
+@_spec("ivf_flat_grouped_pallas",
+       "single-chip grouped flat scan, Pallas sub-chunk-min engine")
+def _flat_pallas(w: _World, count: bool) -> ProgramRecord:
+    return trace_flat_grouped(
+        w.flat_index, _NQ, _K, _P, _QCAP, use_pallas=True,
+        name="ivf_flat_grouped_pallas",
+    )
+
+
+@_spec("ivf_flat_grouped_xla",
+       "single-chip grouped flat scan, legacy XLA engine (bit-stable "
+       "fallback; its wide tile is intentional and pinned)")
+def _flat_xla(w: _World, count: bool) -> ProgramRecord:
+    return trace_flat_grouped(
+        w.flat_index, _NQ, _K, _P, _QCAP, use_pallas=False,
+        name="ivf_flat_grouped_xla",
+    )
+
+
+@_spec("ivf_pq_grouped_pallas",
+       "single-chip grouped ADC scan + exact refine, Pallas engine")
+def _pq_pallas(w: _World, count: bool) -> ProgramRecord:
+    return trace_pq_grouped(
+        w.pq_index, _NQ, _K, _P, _QCAP, use_pallas=True,
+        name="ivf_pq_grouped_pallas",
+    )
+
+
+@_spec("ivf_pq_grouped_onehot",
+       "single-chip grouped ADC scan, legacy one-hot XLA engine — the "
+       "program-level pin of the AST-suppressed adc-gather site")
+def _pq_onehot(w: _World, count: bool) -> ProgramRecord:
+    return trace_pq_grouped(
+        w.pq_index, _NQ, _K, _P, _QCAP, use_pallas=False,
+        name="ivf_pq_grouped_onehot",
+    )
+
+
+@_spec("ivf_pq_per_query",
+       "per-query ADC path (block_q-bounded LUT gather) — the "
+       "program-level pin of the AST-suppressed adc-gather site")
+def _pq_per_query(w: _World, count: bool) -> ProgramRecord:
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.ivf_pq import ivf_pq_search
+
+    q0 = jnp.zeros((_NQ, _D), jnp.float32)
+    traced = _trace_fn(
+        ivf_pq_search, w.pq_index, q0, _K,
+        n_probes=_P, refine_ratio=2.0, block_q=8,
+    )
+    return record_from_traced(
+        "ivf_pq_per_query", traced,
+        {"nq": _NQ, "k": _K, "n_probes": _P, "block_q": 8,
+         "max_list": int(w.pq_index.storage.max_list),
+         "engine": "xla", "allow_wide_tile": True},
+    )
+
+
+@_spec("ivf_sq_grouped_pallas",
+       "single-chip grouped SQ scan, int8 in-kernel dequant engine")
+def _sq_pallas(w: _World, count: bool) -> ProgramRecord:
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.ivf_sq import _flat_view
+
+    sq = w.sq_index
+    return trace_flat_grouped(
+        _flat_view(sq), _NQ, _K, _P, _QCAP, use_pallas=True,
+        dequant=(jnp.asarray(sq.vmin, jnp.float32),
+                 jnp.asarray(sq.vscale, jnp.float32)),
+        name="ivf_sq_grouped_pallas",
+        extra_meta={"int8_slab": True},
+    )
+
+
+@_spec("two_level_probe_kernel",
+       "fused two-level coarse probe, kernelized through the shared "
+       "scan core")
+def _two_level(w: _World, count: bool) -> ProgramRecord:
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.common import (
+        build_coarse_index, two_level_probe,
+    )
+
+    coarse = w._memo("coarse", lambda: build_coarse_index(
+        w.flat_index.centroids, n_super=4, kmeans_n_iters=3, seed=0
+    ))
+    q0 = jnp.zeros((_NQ, _D), jnp.float32)
+    traced = _trace_fn(
+        two_level_probe, q0, coarse.super_cents, coarse.member_ids,
+        coarse.cents_padded, coarse.n_cents, _P, 2,
+        use_pallas=True, pallas_interpret=True,
+    )
+    return record_from_traced(
+        "two_level_probe_kernel", traced,
+        {"nq": _NQ, "n_probes": _P, "n_super": int(coarse.n_super),
+         "max_members": int(coarse.max_members), "engine": "pallas"},
+        donation=False,
+    )
+
+
+def _mnmg_flips(w: _World, index, mutation: bool):
+    """The zero-retrace flip matrix: health up / one rank down /
+    failover route VALUE flipped (rank 3's shard routed to the -1
+    "unserved" sentinel — a real degraded state on an unreplicated
+    index, and crucially a different VALUE so a static derived from the
+    route would prepare a different program) / healed, and (mutation
+    tier) a tombstone value flipped — every entry must prepare the SAME
+    program."""
+    down = np.ones((8,), np.int32)
+    down[3] = 0
+    route = np.zeros((8,), np.int32)
+    route_flip = np.zeros((8,), np.int32)
+    route_flip[3] = -1
+    base = dict(shard_mask=np.ones((8,), np.int32), failover=route)
+    if mutation:
+        base["mutation"] = w.mutation_state(index, 0)
+    flips = [dict(base)]
+    flips.append({**base, "shard_mask": down})
+    flips.append({**base, "shard_mask": down, "failover": route_flip})
+    if mutation:
+        flips.append({**base, "mutation": w.mutation_state(index, 5)})
+    return flips
+
+
+@_spec("mnmg_pq_fused",
+       "sharded IVF-PQ fused one-dispatch program (flat 8-chip mesh, "
+       "Pallas shard-local engine, donated serving queries)")
+def _mnmg_pq(w: _World, count: bool) -> ProgramRecord:
+    from raft_tpu.comms.mnmg_ivf import _prepare_pq_search
+
+    kw = dict(n_probes=_P, qcap=_QCAP, refine_ratio=2.0,
+              use_pallas=True, donate_queries=True)
+    fn, args, _ = _prepare_pq_search(w.comms, w.mnmg_pq, w.q, _K, **kw)
+    traced = fn.trace(*args)
+    return record_from_traced(
+        "mnmg_pq_fused", traced,
+        {"nq": _NQ, "k": _K, "n_probes": _P, "qcap": _QCAP,
+         "max_list": int(w.mnmg_pq.max_list), "engine": "pallas",
+         "expect_donated_queries": True},
+    )
+
+
+@_spec("mnmg_pq_fused_failover_mutation",
+       "sharded IVF-PQ resilient+mutation variant — health, failover "
+       "route, tombstones and delta slabs as runtime inputs; the "
+       "zero-retrace census runs its flip matrix here")
+def _mnmg_pq_failover(w: _World, count: bool) -> ProgramRecord:
+    from raft_tpu.comms.mnmg_ivf import _prepare_pq_search
+
+    def prep(shard_mask, failover, mutation):
+        return _prepare_pq_search(
+            w.comms, w.mnmg_pq, w.q, _K, n_probes=_P, qcap=_QCAP,
+            refine_ratio=2.0, use_pallas=True, shard_mask=shard_mask,
+            failover=failover, mutation=mutation,
+        )
+
+    flips = _mnmg_flips(w, w.mnmg_pq, mutation=True)
+    fn, args, _ = prep(**flips[0])
+    traced = fn.trace(*args)
+    return record_from_traced(
+        "mnmg_pq_fused_failover_mutation", traced,
+        {"nq": _NQ, "k": _K, "n_probes": _P, "qcap": _QCAP,
+         "max_list": int(w.mnmg_pq.max_list), "engine": "pallas",
+         "degraded": True, "mutation": True},
+        program_count=flip_census(prep, flips) if count else None,
+    )
+
+
+@_spec("mnmg_flat_fused",
+       "sharded IVF-Flat fused one-dispatch program (flat 8-chip mesh, "
+       "Pallas shard-local engine, donated serving queries)")
+def _mnmg_flat(w: _World, count: bool) -> ProgramRecord:
+    from raft_tpu.comms.mnmg_ivf_flat import _prepare_flat_family
+
+    fn, args, _ = _prepare_flat_family(
+        w.comms, w.mnmg_flat, w.q, _K, sq=False, n_probes=_P,
+        qcap=_QCAP, list_block=8, qcap_max_drop_frac=None,
+        donate_queries=True, shard_mask=None, failover=None,
+        overprobe=2.0, merge_ways=None, mutation=None, wire="bf16",
+        use_pallas=True, rerank_ratio=4.0,
+    )
+    traced = fn.trace(*args)
+    return record_from_traced(
+        "mnmg_flat_fused", traced,
+        {"nq": _NQ, "k": _K, "n_probes": _P, "qcap": _QCAP,
+         "max_list": int(w.mnmg_flat.max_list), "engine": "pallas",
+         "expect_donated_queries": True},
+    )
+
+
+@_spec("mnmg_flat_fused_failover_mutation",
+       "sharded IVF-Flat resilient+mutation variant with its "
+       "zero-retrace flip census")
+def _mnmg_flat_failover(w: _World, count: bool) -> ProgramRecord:
+    from raft_tpu.comms.mnmg_ivf_flat import _prepare_flat_family
+
+    def prep(shard_mask, failover, mutation):
+        return _prepare_flat_family(
+            w.comms, w.mnmg_flat, w.q, _K, sq=False, n_probes=_P,
+            qcap=_QCAP, list_block=8, qcap_max_drop_frac=None,
+            donate_queries=False, shard_mask=shard_mask,
+            failover=failover, overprobe=2.0, merge_ways=None,
+            mutation=mutation, wire="bf16", use_pallas=True,
+            rerank_ratio=4.0,
+        )
+
+    flips = _mnmg_flips(w, w.mnmg_flat, mutation=True)
+    fn, args, _ = prep(**flips[0])
+    traced = fn.trace(*args)
+    return record_from_traced(
+        "mnmg_flat_fused_failover_mutation", traced,
+        {"nq": _NQ, "k": _K, "n_probes": _P, "qcap": _QCAP,
+         "max_list": int(w.mnmg_flat.max_list), "engine": "pallas",
+         "degraded": True, "mutation": True},
+        program_count=flip_census(prep, flips) if count else None,
+    )
+
+
+@_spec("mnmg_pq_hier_merge",
+       "sharded IVF-PQ on the 2x4 host-sim mesh — the hierarchical "
+       "ICI x DCN merge tail with the compressed bf16+id wire")
+def _mnmg_hier(w: _World, count: bool) -> ProgramRecord:
+    from raft_tpu.comms.mnmg_ivf import _prepare_pq_search
+    from raft_tpu.comms.multihost import hier_axes
+
+    comms = w.hier_comms
+    h = hier_axes(comms.mesh, comms.axis)
+    fn, args, _ = _prepare_pq_search(
+        comms, w.mnmg_pq, w.q, _K, n_probes=_P, qcap=_QCAP,
+        refine_ratio=2.0, use_pallas=True, wire="bf16",
+    )
+    traced = fn.trace(*args)
+    return record_from_traced(
+        "mnmg_pq_hier_merge", traced,
+        {"nq": _NQ, "k": _K, "n_probes": _P, "qcap": _QCAP,
+         "max_list": int(w.mnmg_pq.max_list), "engine": "pallas",
+         "dcn_axes": (h[0],), "dcn_wire": "bf16", "n_hosts": h[2]},
+    )
+
+
+def audit_all(*, count: bool = True, names=None) -> Dict[str, ProgramRecord]:
+    """Build every (or the named subset of) registry record. Tracing
+    only — nothing dispatches to devices."""
+    w = _World.get()
+    out: Dict[str, ProgramRecord] = {}
+    for spec in SPECS:
+        if names is not None and spec.name not in names:
+            continue
+        out[spec.name] = spec.build(w, count)
+    return out

@@ -1,0 +1,142 @@
+"""The jaxpr walker — pass 0 of the program auditor.
+
+Where :mod:`raft_tpu.analysis.facts` gathers facts from SOURCE TEXT, this
+module walks a **traced jaxpr**: the program XLA will actually compile,
+after jit inlining, shard_map staging, and scan batching have happened.
+Every equation is visited exactly once, recursing through any parameter
+that holds a sub-jaxpr — ``pjit``'s ``jaxpr``, ``shard_map``'s body,
+``scan``/``while``'s carried bodies, ``cond``/``switch``'s branch tuple,
+``custom_jvp/vjp`` call jaxprs — so a hazard cannot hide one staging
+level down.
+
+Each visit yields an :class:`EqnSite` carrying the equation plus the
+*context* the passes key on:
+
+* ``path`` — the chain of enclosing primitives (``("pjit", "shard_map",
+  "scan")``), for human-readable findings;
+* ``in_scan`` — true inside any ``scan``/``while`` body (including the
+  ``lax.map`` lowering), where a materialized intermediate is paid once
+  per iteration and a wide tile is the
+  ``wide-distance-materialize`` hazard's program-level twin;
+* ``in_kernel`` — true inside a ``pallas_call`` kernel jaxpr, whose
+  values live in VMEM refs: *not* HBM materialization, so the
+  materialization model skips them (that is the entire point of the
+  kernels).
+
+The walker is deliberately schema-free: sub-jaxprs are discovered by
+*type* (any ``Jaxpr``/``ClosedJaxpr`` parameter value, directly or inside
+a tuple/list), not by a hand-maintained primitive table, so a new JAX
+release's staging primitives are walked without a code change here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# the only jax import the walker needs; kept narrow so the AST tier never
+# pays a jax import through this package's import chain
+from jax._src import core as _jcore
+
+# primitives whose body runs once per iteration: an f32 intermediate here
+# is re-materialized every step (lax.map lowers to scan, so it is covered)
+_LOOP_PRIMS = frozenset({"scan", "while"})
+
+# Pallas kernels: the sub-jaxpr operates on VMEM refs — its intermediates
+# are the kernel's working set, not HBM materialization
+_KERNEL_PRIMS = frozenset({"pallas_call"})
+
+
+@dataclasses.dataclass(frozen=True)
+class EqnSite:
+    """One visited equation with its staging context."""
+
+    eqn: object                 # jax.core.JaxprEqn
+    path: Tuple[str, ...]       # enclosing primitive names, outermost first
+    in_scan: bool               # inside a scan/while body (incl. lax.map)
+    in_kernel: bool             # inside a pallas_call kernel jaxpr
+
+    @property
+    def prim(self) -> str:
+        return self.eqn.primitive.name
+
+
+def _as_jaxpr(v) -> Optional[object]:
+    if isinstance(v, _jcore.ClosedJaxpr):
+        return v.jaxpr
+    if isinstance(v, _jcore.Jaxpr):
+        return v
+    return None
+
+
+def sub_jaxprs(eqn) -> List[object]:
+    """Every sub-jaxpr reachable from an equation's params, discovered by
+    type (scalar param, or inside a tuple/list like ``cond`` branches)."""
+    out: List[object] = []
+    for v in eqn.params.values():
+        j = _as_jaxpr(v)
+        if j is not None:
+            out.append(j)
+        elif isinstance(v, (tuple, list)):
+            for e in v:
+                j = _as_jaxpr(e)
+                if j is not None:
+                    out.append(j)
+    return out
+
+
+def walk_jaxpr(jaxpr, *, into_kernels: bool = True) -> Iterator[EqnSite]:
+    """Yield every equation of ``jaxpr`` (a ``Jaxpr`` or ``ClosedJaxpr``)
+    and, recursively, of every sub-jaxpr. ``into_kernels=False`` stops at
+    ``pallas_call`` boundaries entirely; the default walks them but marks
+    the sites ``in_kernel`` so passes can choose."""
+    root = jaxpr.jaxpr if isinstance(jaxpr, _jcore.ClosedJaxpr) else jaxpr
+    stack: List[Tuple[object, Tuple[str, ...], bool, bool]] = [
+        (root, (), False, False)
+    ]
+    while stack:
+        j, path, in_scan, in_kernel = stack.pop()
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            yield EqnSite(eqn, path, in_scan, in_kernel)
+            subs = sub_jaxprs(eqn)
+            if not subs:
+                continue
+            k = in_kernel or name in _KERNEL_PRIMS
+            if name in _KERNEL_PRIMS and not into_kernels:
+                continue
+            s = in_scan or name in _LOOP_PRIMS
+            for sub in subs:
+                stack.append((sub, path + (name,), s, k))
+
+
+def aval_bytes(aval) -> int:
+    """HBM bytes of one abstract value (0 for non-array avals)."""
+    dtype = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", None)
+    if dtype is None or shape is None:
+        return 0
+    try:
+        return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    except TypeError:       # symbolic dims — out of the byte model's scope
+        return 0
+
+
+def out_bytes(eqn) -> int:
+    """Total bytes of an equation's outputs — the materialization model's
+    unit of account (one equation == one XLA-visible intermediate)."""
+    return sum(aval_bytes(v.aval) for v in eqn.outvars)
+
+
+def collective_axes(eqn) -> Tuple[str, ...]:
+    """The mesh axis names a collective runs over, normalized to a tuple
+    of strings (``axes`` on psum-family, ``axis_name`` on gather-family);
+    empty when the equation is not a named-axis collective."""
+    ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if ax is None:
+        return ()
+    if isinstance(ax, (tuple, list, frozenset, set)):
+        return tuple(str(a) for a in ax)
+    return (str(ax),)

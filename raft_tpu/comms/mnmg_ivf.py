@@ -140,7 +140,8 @@ class MnmgIVFPQIndex:
                shard_mask=None, failover=None, overprobe: float = 2.0,
                merge_ways: typing.Optional[int] = None,
                use_pallas: typing.Optional[bool] = None,
-               mutation=None, wire: str = "bf16") -> int:
+               mutation=None, wire: str = "bf16",
+               audit: bool = False) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches: one all-zeros batch runs through
         :func:`mnmg_ivf_pq_search` and is blocked on, so the first real
@@ -155,7 +156,15 @@ class MnmgIVFPQIndex:
         ``shard_mask=``/``PartialSearchResult`` program —
         docs/robustness.md); the mask AND the replica-failover route
         are runtime inputs, so one warm-up covers every later health
-        and failover state."""
+        and failover state.
+
+        ``audit=True`` re-traces the warmed fused program through the
+        jaxpr-level program auditor (:mod:`raft_tpu.analysis.program`;
+        docs/static_analysis.md "Two tiers") and raises listing the
+        findings when it violates the serving-tier invariants — wide
+        cross-host collectives, an uncompressed DCN wire, scan-path f32
+        tiles, 64-bit dtypes, or (with ``donate_queries=True``) queries
+        the lowering does not actually donate."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -171,6 +180,44 @@ class MnmgIVFPQIndex:
             mutation=mutation, wire=wire,
         )
         jax.block_until_ready(out)
+        if audit:
+            from raft_tpu.analysis.program import audit_warmed
+            from raft_tpu.analysis.program.registry import (
+                record_from_traced,
+            )
+
+            fn, args, _ = _prepare_pq_search(
+                comms, self, q0, k, n_probes=n_probes, qcap=qc,
+                list_block=list_block, refine_ratio=refine_ratio,
+                exact_selection=exact_selection,
+                approx_recall_target=approx_recall_target,
+                donate_queries=donate_queries, shard_mask=shard_mask,
+                failover=failover, overprobe=overprobe,
+                merge_ways=merge_ways, use_pallas=use_pallas,
+                mutation=mutation, wire=wire,
+            )
+            h = hier_axes(comms.mesh, comms.axis)
+            # the wrapper's own engine resolution: in kernel mode the
+            # wide tile is a finding, in XLA-fallback mode intentional
+            # (docs/ivf_scale.md)
+            from raft_tpu.spatial.ann.ivf_pq import _resolve_adc_engine
+
+            up = _resolve_adc_engine(
+                use_pallas,
+                self.vectors_sorted is not None and refine_ratio > 1.0,
+                self.pq_dim, self.pq_bits, qc,
+            )
+            audit_warmed(record_from_traced(
+                "mnmg_ivf_pq_warm", fn.trace(*args),
+                {
+                    "nq": nq, "k": k, "n_probes": n_probes, "qcap": qc,
+                    "max_list": int(self.max_list),
+                    "allow_wide_tile": not up,
+                    "expect_donated_queries": bool(donate_queries),
+                    "dcn_axes": () if h is None else (h[0],),
+                    "dcn_wire": wire,
+                },
+            ))
         return qc
 
 
@@ -1613,6 +1660,48 @@ def mnmg_ivf_pq_search(
     by construction). Ignored on 1-level meshes; docs/multihost.md
     states the byte model and the quantization contract.
     """
+    fn, args, degraded = _prepare_pq_search(
+        comms, index, queries, k, n_probes=n_probes, qcap=qcap,
+        list_block=list_block, refine_ratio=refine_ratio,
+        exact_selection=exact_selection,
+        approx_recall_target=approx_recall_target,
+        qcap_max_drop_frac=qcap_max_drop_frac,
+        donate_queries=donate_queries, shard_mask=shard_mask,
+        failover=failover, overprobe=overprobe, merge_ways=merge_ways,
+        use_pallas=use_pallas, mutation=mutation, wire=wire,
+    )
+    if not degraded:
+        return fn(*args)
+    md, mi, cov, rv = fn(*args)
+    return PartialSearchResult(
+        distances=md, ids=mi, coverage=cov, row_valid=rv
+    )
+
+
+def _prepare_pq_search(
+    comms: Comms, index: MnmgIVFPQIndex, queries, k: int, *,
+    n_probes: int = 8, qcap: typing.Union[int, str, None] = None,
+    list_block: int = 8,
+    refine_ratio: float = 2.0, exact_selection: bool = True,
+    approx_recall_target: float = 0.95,
+    qcap_max_drop_frac: typing.Optional[float] = None,
+    donate_queries: bool = False,
+    shard_mask=None,
+    failover=None,
+    overprobe: float = 2.0,
+    merge_ways: typing.Optional[int] = None,
+    use_pallas: typing.Optional[bool] = None,
+    mutation=None,
+    wire: str = "bf16",
+):
+    """The non-dispatching front half of :func:`mnmg_ivf_pq_search`:
+    validation, engine/static resolution, program-cache lookup, and
+    operand assembly — returns ``(fn, args, degraded)`` with the fused
+    program UN-invoked. The program auditor
+    (:mod:`raft_tpu.analysis.program`) traces ``fn`` over ``args``
+    abstractly and runs its cached-program census across runtime-value
+    flips through THIS path, so what it audits is byte-for-byte the
+    serving entry's own preparation — the two can never drift."""
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
     errors.check_same_cols(q, index.centroids, "queries", "index")
@@ -1679,15 +1768,12 @@ def mnmg_ivf_pq_search(
         index.list_offsets, index.list_sizes, q, sup_c, mem_i, cpad,
     )
     if not degraded:
-        return fn(*args, *(mut_args or ()))
+        return fn, args + tuple(mut_args or ()), False
     alive = resolve_shard_mask(shard_mask, comms.size)
     route = resolve_route(
         failover, comms.size, int(index.replication),
         int(index.replica_offset),
     )
-    md, mi, cov, rv = fn(
-        *args, jnp.asarray(alive), jnp.asarray(route), *(mut_args or ())
-    )
-    return PartialSearchResult(
-        distances=md, ids=mi, coverage=cov, row_valid=rv
-    )
+    return fn, args + (
+        jnp.asarray(alive), jnp.asarray(route),
+    ) + tuple(mut_args or ()), True

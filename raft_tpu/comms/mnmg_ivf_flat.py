@@ -127,7 +127,7 @@ class MnmgIVFFlatIndex:
                merge_ways: typing.Optional[int] = None,
                mutation=None, wire: str = "bf16",
                use_pallas: typing.Optional[bool] = None,
-               rerank_ratio: float = 4.0) -> int:
+               rerank_ratio: float = 4.0, audit: bool = False) -> int:
         """Pre-compile the sharded serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through
         :func:`mnmg_ivf_flat_search` — the Flat sibling of
@@ -138,7 +138,10 @@ class MnmgIVFFlatIndex:
         ``shard_mask=True`` to warm the resilient variant instead
         (docs/robustness.md); the mask and the replica-failover route
         are runtime inputs, so one warm-up covers every later health
-        and failover state."""
+        and failover state. ``audit=True`` re-traces the warmed fused
+        program through the jaxpr-level program auditor and raises on
+        findings (:mod:`raft_tpu.analysis.program`; see
+        :meth:`~raft_tpu.comms.mnmg_ivf.MnmgIVFPQIndex.warmup`)."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -152,6 +155,16 @@ class MnmgIVFFlatIndex:
             rerank_ratio=rerank_ratio,
         )
         jax.block_until_ready(out)
+        if audit:
+            _audit_flat_family_warm(
+                comms, self, q0, k, sq=False, n_probes=n_probes,
+                qcap=qc, list_block=list_block,
+                donate_queries=donate_queries, shard_mask=shard_mask,
+                failover=failover, overprobe=overprobe,
+                merge_ways=merge_ways, mutation=mutation, wire=wire,
+                use_pallas=use_pallas, rerank_ratio=rerank_ratio,
+                name="mnmg_ivf_flat_warm",
+            )
         return qc
 
 
@@ -576,6 +589,34 @@ def _flat_family_search(
     the int8 code slab in the ``vectors_sorted`` operand slot), and the
     degraded/failover tail. Returns squared distances; the flat wrapper
     applies its metric sqrt on top."""
+    fn, args, degraded = _prepare_flat_family(
+        comms, index, queries, k, sq=sq, n_probes=n_probes, qcap=qcap,
+        list_block=list_block, qcap_max_drop_frac=qcap_max_drop_frac,
+        donate_queries=donate_queries, shard_mask=shard_mask,
+        failover=failover, overprobe=overprobe, merge_ways=merge_ways,
+        mutation=mutation, wire=wire, use_pallas=use_pallas,
+        rerank_ratio=rerank_ratio,
+    )
+    if not degraded:
+        return fn(*args)
+    md, mi, cov, rv = fn(*args)
+    return PartialSearchResult(
+        distances=md, ids=mi, coverage=cov, row_valid=rv
+    )
+
+
+def _prepare_flat_family(
+    comms: Comms, index, queries, k: int, *, sq: bool, n_probes,
+    qcap, list_block, qcap_max_drop_frac, donate_queries, shard_mask,
+    failover, overprobe, merge_ways, mutation, wire, use_pallas,
+    rerank_ratio,
+):
+    """The non-dispatching front half of :func:`_flat_family_search` —
+    returns ``(fn, args, degraded)`` with the fused program UN-invoked,
+    exactly like :func:`raft_tpu.comms.mnmg_ivf._prepare_pq_search`.
+    The program auditor (:mod:`raft_tpu.analysis.program`) traces and
+    flip-censuses through this path, so the audited preparation IS the
+    serving entry's own."""
     q = jnp.asarray(queries)
     errors.check_matrix(q, "queries")
     errors.check_same_cols(q, index.centroids, "queries", "index")
@@ -653,18 +694,58 @@ def _flat_family_search(
             jnp.asarray(index.vscale, jnp.float32),
         )
     if not degraded:
-        return fn(*args, *(mut_args or ()))
+        return fn, args + tuple(mut_args or ()), False
     alive = resolve_shard_mask(shard_mask, comms.size)
     route = resolve_route(
         failover, comms.size, int(index.replication),
         int(index.replica_offset),
     )
-    md, mi, cov, rv = fn(
-        *args, jnp.asarray(alive), jnp.asarray(route), *(mut_args or ())
+    return fn, args + (
+        jnp.asarray(alive), jnp.asarray(route),
+    ) + tuple(mut_args or ()), True
+
+
+def _audit_flat_family_warm(comms, index, q0, k, *, sq, n_probes, qcap,
+                            list_block, donate_queries, shard_mask,
+                            failover, overprobe, merge_ways, mutation,
+                            wire, use_pallas, rerank_ratio, name):
+    """The flat-family ``warmup(audit=True)`` hook: re-prepare the exact
+    warmed program, trace it abstractly, and run the jaxpr passes —
+    raising listing the findings (:mod:`raft_tpu.analysis.program`)."""
+    from raft_tpu.analysis.program import audit_warmed
+    from raft_tpu.analysis.program.registry import record_from_traced
+
+    fn, args, _ = _prepare_flat_family(
+        comms, index, q0, k, sq=sq, n_probes=n_probes, qcap=qcap,
+        list_block=list_block, qcap_max_drop_frac=None,
+        donate_queries=donate_queries, shard_mask=shard_mask,
+        failover=failover, overprobe=overprobe, merge_ways=merge_ways,
+        mutation=mutation, wire=wire, use_pallas=use_pallas,
+        rerank_ratio=rerank_ratio,
     )
-    return PartialSearchResult(
-        distances=md, ids=mi, coverage=cov, row_valid=rv
-    )
+    # the wrapper's own engine resolution decides whether the XLA
+    # fallback's wide tile is intentional
+    if sq:
+        from raft_tpu.spatial.ann.ivf_sq import _resolve_sq_engine
+
+        up = _resolve_sq_engine(use_pallas, index.centroids.shape[1], qcap)
+    else:
+        from raft_tpu.spatial.ann.ivf_flat import _resolve_scan_engine
+
+        up = _resolve_scan_engine(use_pallas, index.centroids.shape[1],
+                                  qcap)
+    h = hier_axes(comms.mesh, comms.axis)
+    audit_warmed(record_from_traced(
+        name, fn.trace(*args),
+        {
+            "nq": int(q0.shape[0]), "k": k, "n_probes": n_probes,
+            "qcap": qcap, "max_list": int(index.max_list),
+            "allow_wide_tile": not up,
+            "expect_donated_queries": bool(donate_queries),
+            "dcn_axes": () if h is None else (h[0],),
+            "dcn_wire": wire,
+        },
+    ))
 
 
 # --------------------------------------------------------------- IVF-SQ
@@ -715,13 +796,16 @@ class MnmgIVFSQIndex:
                merge_ways: typing.Optional[int] = None,
                mutation=None, wire: str = "bf16",
                use_pallas: typing.Optional[bool] = None,
-               rerank_ratio: float = 4.0) -> int:
+               rerank_ratio: float = 4.0, audit: bool = False) -> int:
         """Pre-compile the sharded SQ serving program for (nq, d)
         float32 batches — the SQ sibling of
         :meth:`MnmgIVFFlatIndex.warmup` (one all-zeros batch through
         :func:`mnmg_ivf_sq_search`, blocked on). Returns the
         shape-only-resolved qcap; pass exactly that integer (and the
-        same ``donate_queries``) on serving dispatches."""
+        same ``donate_queries``) on serving dispatches. ``audit=True``
+        re-traces the warmed fused program through the jaxpr-level
+        program auditor and raises on findings
+        (:mod:`raft_tpu.analysis.program`)."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -735,6 +819,16 @@ class MnmgIVFSQIndex:
             rerank_ratio=rerank_ratio,
         )
         jax.block_until_ready(out)
+        if audit:
+            _audit_flat_family_warm(
+                comms, self, q0, k, sq=True, n_probes=n_probes,
+                qcap=qc, list_block=list_block,
+                donate_queries=donate_queries, shard_mask=shard_mask,
+                failover=failover, overprobe=overprobe,
+                merge_ways=merge_ways, mutation=mutation, wire=wire,
+                use_pallas=use_pallas, rerank_ratio=rerank_ratio,
+                name="mnmg_ivf_sq_warm",
+            )
         return qc
 
 

@@ -67,7 +67,7 @@ class IVFFlatIndex:
                qcap=None, list_block: int = 32,
                stream_partials=None,
                use_pallas: typing.Optional[bool] = None,
-               rerank_ratio: float = 4.0) -> int:
+               rerank_ratio: float = 4.0, audit: bool = False) -> int:
         """Pre-compile the grouped serving program for (nq, d) float32
         batches: one all-zeros batch is dispatched through the exact
         serving entry and blocked on, populating the in-process jit cache
@@ -81,6 +81,12 @@ class IVFFlatIndex:
         pass exactly that integer on every serving dispatch — the warmed
         program is keyed on it, and the data-dependent ``qcap=None`` auto
         path would both host-sync and possibly compile a second program.
+
+        ``audit=True`` additionally traces the warmed program through the
+        jaxpr-level program auditor (:mod:`raft_tpu.analysis.program`;
+        docs/static_analysis.md "Two tiers") and raises listing the
+        findings if it violates the serving-tier invariants — the
+        in-process spot check of the CI gate ``ci/run.sh programs``.
         """
         from raft_tpu.spatial.ann.common import static_qcap
 
@@ -92,6 +98,22 @@ class IVFFlatIndex:
             use_pallas=use_pallas, rerank_ratio=rerank_ratio,
         )
         jax.block_until_ready(out)
+        if audit:
+            from raft_tpu.analysis.program import audit_warmed
+            from raft_tpu.analysis.program.registry import (
+                trace_flat_grouped,
+            )
+
+            # the wrapper's own engine resolution — the audited statics
+            # must be the warmed program's statics
+            up = _resolve_scan_engine(
+                use_pallas, self.centroids.shape[1], qc
+            )
+            audit_warmed(trace_flat_grouped(
+                self, nq, k, n_probes, qc, list_block=list_block,
+                use_pallas=up, rerank_ratio=rerank_ratio,
+                name="ivf_flat_grouped_warm",
+            ))
         return qc
 
 

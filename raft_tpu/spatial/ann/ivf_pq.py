@@ -100,7 +100,8 @@ class IVFPQIndex:
                refine_dataset=None, exact_selection: bool = False,
                approx_recall_target: float = 0.95,
                stream_partials=None,
-               use_pallas: typing.Optional[bool] = None) -> int:
+               use_pallas: typing.Optional[bool] = None,
+               audit: bool = False) -> int:
         """Pre-compile the grouped serving program for (nq, d) float32
         batches by dispatching one all-zeros batch through the exact
         serving entry (in-process jit cache + persistent compilation
@@ -108,7 +109,10 @@ class IVFPQIndex:
         :meth:`raft_tpu.spatial.ann.ivf_flat.IVFFlatIndex.warmup`.
 
         Returns the shape-only-resolved qcap; pass exactly that integer
-        on serving dispatches (see IVFFlatIndex.warmup for why)."""
+        on serving dispatches (see IVFFlatIndex.warmup for why).
+        ``audit=True`` runs the jaxpr-level program auditor over the
+        warmed program and raises on findings
+        (:mod:`raft_tpu.analysis.program`; see IVFFlatIndex.warmup)."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -123,6 +127,26 @@ class IVFPQIndex:
             use_pallas=use_pallas,
         )
         jax.block_until_ready(out)
+        if audit:
+            from raft_tpu.analysis.program import audit_warmed
+            from raft_tpu.analysis.program.registry import (
+                trace_pq_grouped,
+            )
+
+            refine_active = (
+                self.vectors_sorted is not None
+                or refine_dataset is not None
+            ) and refine_ratio > 1.0
+            up = _resolve_adc_engine(
+                use_pallas, refine_active, self.pq_dim, self.pq_bits, qc
+            )
+            audit_warmed(trace_pq_grouped(
+                self, nq, k, n_probes, qc, list_block=list_block,
+                refine_ratio=refine_ratio,
+                exact_selection=exact_selection,
+                approx_recall_target=approx_recall_target,
+                use_pallas=up, name="ivf_pq_grouped_warm",
+            ))
         return qc
 
 
@@ -449,7 +473,17 @@ def ivf_pq_search(
         codes = index.codes_sorted[cand_pos].astype(jnp.int32)  # (q,p,L,M)
         # dist[q,p,l] = sum_m lut[q,p,m,codes[q,p,l,m]]
         lut_t = lut.transpose(0, 1, 3, 2)                    # (q, p, K, M)
-        gath = jnp.take_along_axis(lut_t, codes, axis=2)     # (q, p, L, M)
+        # the INTENTIONAL per-query LUT gather, kept for small-batch
+        # latency. Proved bounded by the program auditor: the
+        # `ivf_pq_per_query` entry in ci/checks/program_contracts.json
+        # pins this program's peak per-equation intermediate at the
+        # block_q-blocked (blk, p, L, M) gather tile — the
+        # materialization-model pass would flag any regression that
+        # widens it (docs/static_analysis.md "Two tiers"), so the AST
+        # grandfather entry is retired for this inline proof.
+        gath = jnp.take_along_axis(  # jaxlint: disable=adc-gather
+            lut_t, codes, axis=2
+        )                                                    # (q, p, L, M)
         d2 = jnp.sum(gath, axis=3)                           # (q, p, L)
 
         valid = cand_pos < index.storage.n
@@ -631,7 +665,16 @@ def _pq_grouped_impl(index, q, k, n_probes, qcap, list_block, refine_ratio,
         onehot = (
             codes[..., None] == jnp.arange(K, dtype=jnp.uint8)
         ).astype(bf16)                                       # (LB, L, M, K)
-        d2 = jax.lax.dot_general(
+        # the INTENTIONAL legacy one-hot engine, kept as the
+        # use_pallas=False CPU/interpret fallback. Proved pinned by the
+        # program auditor: the `ivf_pq_grouped_onehot` entry in
+        # ci/checks/program_contracts.json snapshots this engine's
+        # scan-path f32 tiles and peak intermediate bytes, and the
+        # Pallas serving entry (`ivf_pq_grouped_pallas`) pins ZERO wide
+        # tiles — a new one-hot spelling anywhere else fails the AST
+        # rule outright now that the baseline entry is retired for this
+        # inline proof (docs/static_analysis.md "Two tiers").
+        d2 = jax.lax.dot_general(  # jaxlint: disable=adc-gather
             lut.reshape(LB, qcap, M * K).astype(bf16),
             onehot.reshape(LB, L, M * K),
             (((2,), (2,)), ((0,), (0,))),

@@ -93,7 +93,7 @@ class IVFSQIndex:
                qcap=None, list_block: int = 32,
                stream_partials=None,
                use_pallas: typing.Optional[bool] = None,
-               rerank_ratio: float = 4.0) -> int:
+               rerank_ratio: float = 4.0, audit: bool = False) -> int:
         """Pre-compile the grouped SQ serving program for (nq, d) float32
         batches — the SQ sibling of :meth:`IVFFlatIndex.warmup`: one
         all-zeros batch is dispatched through
@@ -101,7 +101,9 @@ class IVFSQIndex:
         batch pays dispatch, not trace+compile. ``qcap`` resolves
         SHAPE-ONLY (:func:`...ann.common.static_qcap`) and the resolved
         value is returned; pass exactly that integer on every serving
-        dispatch (docs/serving.md)."""
+        dispatch (docs/serving.md). ``audit=True`` runs the jaxpr-level
+        program auditor over the warmed program and raises on findings
+        (:mod:`raft_tpu.analysis.program`; see IVFFlatIndex.warmup)."""
         from raft_tpu.spatial.ann.common import static_qcap
 
         qc = static_qcap(qcap, nq, n_probes, self.centroids.shape[0])
@@ -112,6 +114,24 @@ class IVFSQIndex:
             use_pallas=use_pallas, rerank_ratio=rerank_ratio,
         )
         jax.block_until_ready(out)
+        if audit:
+            from raft_tpu.analysis.program import audit_warmed
+            from raft_tpu.analysis.program.registry import (
+                trace_flat_grouped,
+            )
+
+            up = _resolve_sq_engine(
+                use_pallas, self.centroids.shape[1], qc
+            )
+            audit_warmed(trace_flat_grouped(
+                _flat_view(self), nq, k, n_probes, qc,
+                list_block=list_block, use_pallas=up,
+                rerank_ratio=rerank_ratio,
+                dequant=(jnp.asarray(self.vmin, jnp.float32),
+                         jnp.asarray(self.vscale, jnp.float32)),
+                name="ivf_sq_grouped_warm",
+                extra_meta={"int8_slab": True},
+            ))
         return qc
 
 

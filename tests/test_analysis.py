@@ -205,6 +205,134 @@ def test_tracer_safety_builtin_map_is_not_a_transform():
     assert len(out2) == 1
 
 
+def test_facts_decorator_factory_assigned_before_use():
+    """ISSUE 12 satellite (facts.py edge case): a transform factory
+    bound by ASSIGNMENT — ``jit_k = partial(jax.jit, static_argnames=
+    ("mode",))`` — marks every ``@jit_k`` function as traced, with the
+    factory call's statics honored (``mode`` may branch; the traced
+    param may not)."""
+    out = findings("""
+        import jax
+        from functools import partial
+
+        jit_k = partial(jax.jit, static_argnames=("mode",))
+
+        @jit_k
+        def f(x, mode):
+            if mode == "fast":          # static via the factory: fine
+                return x
+            if x > 0:                   # traced param: flagged
+                return x
+            return -x
+    """, "tracer-safety")
+    assert len(out) == 1
+    assert out[0].line != 0
+
+
+def test_facts_factory_call_form_and_partial_alias():
+    """The factory works in CALL form too (``jit_k(body)``), through an
+    aliased ``partial`` import (``from functools import partial as P``)
+    — and a factory over a NON-transform never marks anything."""
+    out = findings("""
+        import numpy as np
+        import jax
+        from functools import partial as P
+
+        jit_k = P(jax.jit, static_argnames=("mode",))
+
+        def body(x, mode):
+            return np.asarray(x)
+
+        g = jit_k(body)
+    """, "tracer-safety")
+    assert len(out) == 1
+    out2 = findings("""
+        import numpy as np
+        from functools import partial
+
+        runner = partial(sorted, reverse=True)
+
+        @runner
+        def h(x):
+            return np.asarray(x)        # not traced: numpy is fine
+    """, "tracer-safety")
+    assert out2 == []
+
+
+def test_facts_plain_transform_rebinding_alias():
+    """``jit2 = jax.jit`` rebinding: both the decorator and call form
+    resolve through the assignment alias."""
+    out = findings("""
+        import numpy as np
+        import jax
+
+        jit2 = jax.jit
+
+        @jit2
+        def f(x):
+            return np.asarray(x)
+    """, "tracer-safety")
+    assert len(out) == 1
+    out2 = findings("""
+        import numpy as np
+        import jax
+
+        jit2 = jax.jit
+
+        def body(x):
+            return np.asarray(x)
+
+        g = jit2(body)
+    """, "tracer-safety")
+    assert len(out2) == 1
+
+
+def test_facts_import_alias_chains():
+    """Aliasing through ``from x import y as z`` chains: the origin
+    path resolves through the rename, so the hazard cannot hide behind
+    an alias — and an unrelated local name shadowing a transform tail
+    stays clean."""
+    out = findings("""
+        import numpy as np
+        from jax import lax as looper
+
+        def convert(x):
+            return np.asarray(x)
+
+        rows = looper.map(convert, batch)
+    """, "tracer-safety")
+    assert len(out) == 1
+    # the same alias passing its callable to a NON-transform attribute
+    # marks nothing (origin tracked, tail still decides)
+    out2 = findings("""
+        import numpy as np
+        from jax import lax as looper
+
+        def convert(x):
+            return np.asarray(x)
+
+        rows = looper.stop_gradient(convert)
+    """, "tracer-safety")
+    assert out2 == []
+
+
+def test_facts_factory_self_rebinding_terminates():
+    """``j = partial(j, ...)`` rebinding must not cycle the resolver
+    (depth-bounded factory chains)."""
+    out = findings("""
+        import jax
+        from functools import partial
+
+        j = partial(jax.jit, static_argnames=("k",))
+        j = partial(j, static_argnames=("k",))
+
+        @j
+        def f(x, k):
+            return x
+    """, "tracer-safety")
+    assert out == []
+
+
 # -- recompile-hazard --------------------------------------------------------
 
 def test_recompile_hazard_dynamic_static_spec():
@@ -728,15 +856,20 @@ def test_wide_distance_legacy_flat_scan_inline_suppressed():
 
 
 def test_baseline_entries_match_live_findings_no_drift():
-    """The stale-baseline drift check (ISSUE 11 satellite): every entry
-    the committed baseline still grandfathers must match a LIVE finding
-    at its exact budgeted count — a baselined line that was since fixed
-    (or inline-suppressed) must be REMOVED from the baseline, or the
-    burn-down ratchet silently loosens. Conversely no live finding may
-    exceed its budget (the repo lints clean — CI's hard gate,
-    re-asserted here next to the drift direction it cannot see)."""
+    """The stale-baseline drift check (ISSUE 11 satellite, scope widened
+    r12): every entry the committed baseline still grandfathers must
+    match a LIVE finding at its exact budgeted count — a baselined line
+    that was since fixed (or inline-suppressed) must be REMOVED from the
+    baseline, or the burn-down ratchet silently loosens. Conversely no
+    live finding may exceed its budget (the repo lints clean — CI's hard
+    gate, re-asserted here next to the drift direction it cannot see).
+    The lint scope is the FULL gated target set (raft_tpu + tests +
+    bench + ci + the top-level scripts, exactly ci/run.sh's list), so a
+    future baseline entry under tests/ or bench/ is drift-checked too."""
     base = Baseline.load(REPO / "ci" / "checks" / "jaxlint_baseline.json")
-    result = lint_paths([REPO / "raft_tpu"], root=REPO)
+    targets = ["raft_tpu", "tests", "bench", "ci",
+               "bench.py", "__graft_entry__.py"]
+    result = lint_paths([REPO / t for t in targets], root=REPO)
     live: dict = {}
     for f in result.findings:
         live[f.baseline_key] = live.get(f.baseline_key, 0) + 1
@@ -749,11 +882,39 @@ def test_baseline_entries_match_live_findings_no_drift():
             f"baseline entry no longer matches a live finding "
             f"(live {live.get(key, 0)} != budget {budget}): {key}"
         )
-    # the two remaining grandfathered findings are the legacy ADC
-    # gathers — the burn-down target of the next kernel milestone
-    assert sorted(base.counts) == sorted(
-        k for k in base.counts if "::adc-gather::" in k
-    ) and len(base.counts) == 2
+
+
+def test_adc_gather_baseline_burned_down_to_inline_proofs():
+    """ISSUE 12 satellite: the last two grandfathered ``adc-gather``
+    findings (the per-query LUT gather and the grouped one-hot engine,
+    both in spatial/ann/ivf_pq.py) are re-verified at the PROGRAM level
+    — `ivf_pq_per_query` and `ivf_pq_grouped_onehot` in
+    ci/checks/program_contracts.json pin their materialization — and
+    carry inline suppressions naming that proof, so the baseline is now
+    EMPTY: any new adc-gather spelling anywhere fails CI immediately,
+    with no grandfather budget left to absorb it."""
+    base = Baseline.load(REPO / "ci" / "checks" / "jaxlint_baseline.json")
+    assert base.counts == {}, base.counts
+    # the inline proofs exist and name the contract entries
+    src = (REPO / "raft_tpu" / "spatial" / "ann" / "ivf_pq.py").read_text()
+    assert src.count("jaxlint: disable=adc-gather") >= 3  # 2 proofs + remap
+    assert "ivf_pq_per_query" in src
+    assert "ivf_pq_grouped_onehot" in src
+    contracts = json.loads(
+        (REPO / "ci" / "checks" / "program_contracts.json").read_text()
+    )["programs"]
+    assert "ivf_pq_per_query" in contracts
+    assert "ivf_pq_grouped_onehot" in contracts
+    # the rule still fires on fresh spellings (no silent weakening)
+    out = findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def scan(lut_t, codes):
+            return jnp.take_along_axis(lut_t, codes, axis=2)
+    """, rule="adc-gather")
+    assert len(out) == 1
 
 
 # -- mutation-retrace --------------------------------------------------------
@@ -1146,6 +1307,38 @@ def test_cli_rule_filter_and_list(tmp_path):
 def test_every_rule_has_description(rule):
     r = next(r for r in ALL_RULES if r.name == rule)
     assert r.description
+
+
+def test_rule_docs_and_cli_parity():
+    """ISSUE 12 satellite: a rule cannot land undocumented. Every rule
+    id registered in raft_tpu/analysis/rules/__init__.py must have a
+    ``### `rule-id` `` heading in docs/static_analysis.md AND print from
+    ``--list-rules`` — and the program-auditor passes (the second tier)
+    are held to the same bar against their own docs section and
+    ``--list-programs`` is exercised by tests/test_program_audit.py."""
+    docs = (REPO / "docs" / "static_analysis.md").read_text()
+    for r in ALL_RULES:
+        assert f"### `{r.name}`" in docs, (
+            f"rule {r.name} has no '### `{r.name}`' heading in "
+            "docs/static_analysis.md"
+        )
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    for r in ALL_RULES:
+        assert f"{r.name}:" in proc.stdout, r.name
+    # the program tier's passes are documented in the same file
+    from raft_tpu.analysis.program.passes import ALL_PASSES
+
+    for p in ALL_PASSES:
+        assert f"### `{p.name}`" in docs, (
+            f"program pass {p.name} has no '### `{p.name}`' heading in "
+            "docs/static_analysis.md"
+        )
+        assert p.description
+    assert "### `program-contract`" in docs  # the drift rule too
 
 
 def test_repo_lints_clean():

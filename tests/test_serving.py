@@ -1030,3 +1030,81 @@ def test_round11_bench_line_parses_with_sq_scan_kernel():
     # pressure (the acceptance signal lives in the speedup rows)
     assert "probe_kernel" in benchtop._PRINT_KEYS
     assert "probe_kernel" in benchtop._TRIM_ORDER
+
+def test_round12_bench_line_parses_with_program_audit_stamp():
+    """ISSUE 12 satellite (the _fit_line parse/cap test extended,
+    following the r05-r11 pattern): the round-12 artifact shape — every
+    prior row PLUS the ``program_audit_ms`` stamp on the headline doc
+    (the jaxpr-level contract gate's wall time, docs/static_analysis.md
+    "Two tiers") — must print as a line that json.loads-round-trips
+    under the 1800-char driver cap. ``program_audit_ms`` is
+    deliberately TRIMMABLE (a secondary stamp: the gate's pass/fail
+    lives in ci/run.sh programs, not the bench line) but prints
+    whitelisted, and a red audit's ``program_audit_error`` string
+    survives the _compact string filter so failures are visible on the
+    driver line."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r12", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01}
+        for i in range(8)
+    ] + [
+        {"metric": "sq_scan_kernel_500000x96_q4096_k10_p16",
+         "value": 98765.4, "unit": "QPS", "spread": 0.04, "repeats": 7,
+         "escalations": 1, "scan_engine": "pallas",
+         "recall_at_10": 0.9987, "xla_qps": 31234.5,
+         "xla_recall_at_10": 0.9988, "speedup": 3.16},
+        {"metric": "mnmg_ivf_flat_shard_12500000x96_q16384_k10_p16",
+         "value": 50620.9, "unit": "QPS", "spread": 0.014, "repeats": 7,
+         "scan_engine": "pallas", "probe_kernel": "pallas",
+         "recall_at_10_vs_shard": 0.9994, "qcap8_qps": 130789.3,
+         "measured_chip_qps": 1.2e5, "sharded_e2e_qps": 1.1e5,
+         "vs_prev": 1.05},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        # the round-12 stamp under test
+        "program_audit_ms": 34193.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # the stamp prints when the line has room...
+    small = benchtop._fit_line({
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS",
+        "program_audit_ms": 34193.2, "extras": [],
+    })
+    assert json.loads(small)["program_audit_ms"] == 34193.2
+    # ...is whitelisted-but-trimmable (the r11 acceptance keys are not)
+    assert "program_audit_ms" in benchtop._PRINT_KEYS
+    assert "program_audit_ms" in benchtop._TRIM_ORDER
+    for key in ("speedup", "scan_engine", "recall_at_10"):
+        assert key not in benchtop._TRIM_ORDER
+        assert key in benchtop._PRINT_KEYS
+    # a red audit's error string survives the _compact string filter
+    err = benchtop._compact({
+        "metric": "m", "program_audit_error": "exit 1: drift",
+    })
+    assert err["program_audit_error"] == "exit 1: drift"
+    # and the stamp helper exists with the subprocess contract
+    assert callable(benchtop._program_audit_stamp)
