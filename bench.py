@@ -1321,8 +1321,12 @@ _PRINT_KEYS = {
     "ingest_qps", "upsert_visible_ms", "delete_masked_ms",
     # the open-loop executor row (ISSUE 8, docs/serving.md "Open-loop
     # serving"): measured saturation vs the raw program and the
-    # offered-load sweep percentiles at 50/80/95% of saturation
+    # offered-load sweep percentiles at 50/80/95% of saturation;
+    # obs_overhead_pct (ISSUE 13, docs/observability.md) is the
+    # telemetry tax — saturation with the metric registry enabled vs
+    # RAFT_TPU_OBS=off, acceptance <= ~2%
     "program_qps", "saturation_qps", "qps_ratio_vs_program",
+    "obs_overhead_pct",
     "p50_ms_50", "p99_ms_50", "p50_ms_80", "p99_ms_80",
     "p50_ms_95", "p99_ms_95", "shed_rate_95",
     # the cross-host serving row (ISSUE 9, docs/multihost.md): host-sim
@@ -1356,6 +1360,7 @@ _RETIRED_KEYS = ("probe_global_ms", "projected_100m_qps", "merge8_ms")
 _TRIM_ORDER = (
     "repeats", "within_2x_warm", "escalations", "probe_flop_ratio",
     "probe_kernel", "build_warm_s", "program_audit_ms",
+    "obs_overhead_pct",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",

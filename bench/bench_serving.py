@@ -488,16 +488,38 @@ def open_loop_row(make_run, qall, *, buckets=(128, 1024),
                        max(n_requests, min_duration_s * rate_rps)))
 
     # saturation: offer ~1.5x the program rate; the completion rate IS
-    # the executor's deliverable throughput (sheds excluded)
+    # the executor's deliverable throughput (sheds excluded). Measured
+    # TWICE — registry enabled (the production posture; this is the
+    # reported saturation_qps) and RAFT_TPU_OBS=off — so the row stamps
+    # the telemetry tax directly (`obs_overhead_pct`, ISSUE 13
+    # acceptance: <= ~2%; the executor records its per-stage
+    # histograms into the default registry either way, the gate just
+    # turns every observe into an attribute load)
+    from raft_tpu.obs import metrics as obsm
+
     rate_rps = 1.5 * program_qps / request_size
-    with fresh_executor() as ex:
-        _, _, sat_qps, sat_lag = _drive_open_loop(
-            ex, poisson_arrivals(rate_rps, n_for(rate_rps), seed=seed,
-                                 sizes=request_size),
-            qall, seed=seed,
-        )
+    prev_obs = obsm.set_enabled(True)
+    try:
+        with fresh_executor() as ex:
+            _, _, sat_qps, sat_lag = _drive_open_loop(
+                ex, poisson_arrivals(rate_rps, n_for(rate_rps),
+                                     seed=seed, sizes=request_size),
+                qall, seed=seed,
+            )
+        obsm.set_enabled(False)
+        with fresh_executor() as ex:
+            _, _, sat_qps_off, _ = _drive_open_loop(
+                ex, poisson_arrivals(rate_rps, n_for(rate_rps),
+                                     seed=seed, sizes=request_size),
+                qall, seed=seed,
+            )
+    finally:
+        obsm.set_enabled(prev_obs)
     row["saturation_qps"] = round(sat_qps, 1)
     row["qps_ratio_vs_program"] = round(sat_qps / program_qps, 3)
+    if sat_qps_off > 0:
+        row["obs_overhead_pct"] = round(
+            100.0 * (1.0 - sat_qps / sat_qps_off), 2)
     # generator self-check (bench_full only): a lag comparable to the
     # mean inter-arrival gap means the measured rate was submit-bound
     row["gen_lag_ms_sat"] = round(sat_lag * 1e3, 3)

@@ -63,6 +63,14 @@ run_install_check() {
 }
 
 run_tests() {
+    # Observability smoke first (ISSUE 13): the telemetry layer is what
+    # every OTHER failure will be diagnosed through, so its suite fails
+    # fast before the long mesh run (which repeats it) — the same
+    # fail-fast pattern as the multihost smoke. raft_tpu/obs is linted
+    # with the rest of the tree by run_style (incl. the
+    # metrics-in-traced-body rule it motivates).
+    echo "== observability smoke (tests/test_obs.py) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
     echo "== tests (virtual 8-device CPU mesh) =="
     # Wall time ~9 min on a 1-core host: dominated by jit compile/trace
     # of the shard_map phase programs and bf16-emulated quantizer

@@ -53,6 +53,7 @@ __all__ = [
     "lap",
     "linalg",
     "matrix",
+    "obs",
     "pylibraft",
     "random",
     "resilience",
@@ -68,9 +69,9 @@ __all__ = [
 
 _SUBMODULES = {
     "analysis", "cache", "cluster", "comms", "compat", "core", "distance",
-    "errors", "label", "lap", "linalg", "matrix", "native", "pylibraft",
-    "random", "resilience", "serving", "sparse", "spatial", "spectral",
-    "stats", "testing", "utils",
+    "errors", "label", "lap", "linalg", "matrix", "native", "obs",
+    "pylibraft", "random", "resilience", "serving", "sparse", "spatial",
+    "spectral", "stats", "testing", "utils",
 }
 
 

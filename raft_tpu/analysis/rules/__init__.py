@@ -25,6 +25,7 @@ def _collect() -> List[Rule]:
         adc_gather,
         api_compat,
         dcn_wide_collective,
+        metrics_in_traced_body,
         mutation_retrace,
         prng_discipline,
         recompile_hazard,
@@ -37,7 +38,7 @@ def _collect() -> List[Rule]:
     for mod in (api_compat, tracer_safety, recompile_hazard,
                 x64_hygiene, prng_discipline, adc_gather,
                 mutation_retrace, sync_in_hot_path,
-                dcn_wide_collective):
+                dcn_wide_collective, metrics_in_traced_body):
         out.extend(mod.RULES)
     return out
 

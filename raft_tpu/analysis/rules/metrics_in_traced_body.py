@@ -1,0 +1,180 @@
+"""Rule ``metrics-in-traced-body``: a host-side metric recorder called
+inside a jitted/traced body.
+
+The obs layer (raft_tpu/obs/metrics.py, docs/observability.md) is
+host-side by construction: a ``Counter.inc()``, ``Histogram.observe()``
+or ``Gauge.set()`` mutates Python state under a Python lock. Called
+inside a traced body, it runs ONCE — at trace time — and never again:
+the compiled program contains no trace of it, the metric counts one
+warmup forever, and the dashboard shows a flatline that LOOKS like a
+healthy quiet system while the real traffic goes unrecorded. The same
+applies to the wall-clock reads that feed recorders —
+``time.time()`` / ``time.perf_counter()`` inside a traced body is a
+trace-time constant, so even a recorder called later on the host would
+be fed a duration measured across the TRACE, not the dispatch.
+
+Flagged INSIDE traced bodies only (the executor threads, mutation acks,
+and every other host path record freely):
+
+* ``x.inc(...)`` / ``x.observe(...)`` — the two spellings unique to
+  metric instruments;
+* ``x.set(...)`` when the receiver LOOKS like a metric — its dotted
+  name matches ``counter|gauge|hist(ogram)?|metric|meter`` or carries
+  the repo's gauge-handle ``g`` token (``self._g_coverage``,
+  ``_G_RANKS_UP``; array updates like ``arr.at[i].set(v)`` and
+  ordinary setters never match), or it is directly a
+  ``registry.gauge(...)`` / ``.counter(...)`` / ``.histogram(...)``
+  chain;
+* ``time.time()`` / ``time.perf_counter()`` whose value FEEDS a
+  recorder call in the same traced body — directly as an argument, or
+  through a name assigned from the clock read.
+
+Record around the dispatch, not inside it: stamp on the host before
+and after, or read values back through the executor's demux path
+(which is already host-side). Genuine trace-time bookkeeping that
+happens to share a spelling carries
+``# jaxlint: disable=metrics-in-traced-body`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+from raft_tpu.analysis.rules import Rule
+
+_RECORDER_ATTRS = {"inc", "observe"}
+_REGISTRY_FACTORIES = {"counter", "gauge", "histogram"}
+# metric-shaped receiver names for the `.set()` heuristic: the generic
+# spellings plus the bare `g` token — this codebase's own gauge-handle
+# convention is `_g_coverage` / `_G_RANKS_UP`, and the rule must catch
+# its own instruments' misuse (a bare variable literally named `g` is
+# rare enough in traced bodies to accept)
+_METRIC_NAME = re.compile(
+    r"(^|_)(g|counters?|gauges?|hist|histograms?|metrics?|meters?)($|_)"
+)
+_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic"}
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """Name/Attribute chain with dots normalized to underscores
+    (``self._g_coverage`` -> ``self__g_coverage``), or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return "_".join(reversed(parts))
+    return None
+
+
+class MetricsInTracedBodyRule(Rule):
+    name = "metrics-in-traced-body"
+    description = (
+        "host-side metric recorder (.inc/.observe/.set) or clock read "
+        "feeding one inside a traced body — records once at trace "
+        "time, never at dispatch"
+    )
+
+    def _recorder_call(self, ctx, call: ast.Call) -> Optional[str]:
+        """A description of the metric-recorder call this is, or None."""
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        recv = fn.value
+        if fn.attr in _RECORDER_ATTRS:
+            label = _dotted_name(recv) or "<metric>"
+            return f"{label}.{fn.attr}()"
+        if fn.attr == "set":
+            # only metric-shaped receivers: `arr.at[i].set(v)` (a
+            # Subscript receiver) and ordinary setters must not match
+            d = _dotted_name(recv)
+            if d is not None and _METRIC_NAME.search(d.lower()):
+                return f"{d}.set()"
+            if isinstance(recv, ast.Call) and isinstance(
+                recv.func, ast.Attribute
+            ) and recv.func.attr in _REGISTRY_FACTORIES:
+                return f"registry.{recv.func.attr}(...).set()"
+        return None
+
+    def _clock_call(self, ctx, call: ast.Call) -> Optional[str]:
+        d = ctx.facts.dotted(call.func)
+        if d in _CLOCKS:
+            return d
+        return None
+
+    def _names_in(self, node: ast.AST) -> Set[str]:
+        return {
+            n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+        }
+
+    def check(self, ctx) -> Iterator:
+        seen: set = set()          # nested traced fns share body nodes
+        for fn in ctx.facts.traced:
+            recorders: List[ast.Call] = []
+            clock_calls: List[ast.Call] = []
+            # name -> the clock call it was assigned from
+            clock_names: Dict[str, ast.Call] = {}
+            body = [
+                n for n in ctx.facts.traced_body_nodes(fn)
+                if id(n) not in seen and not seen.add(id(n))
+            ]
+            for node in body:
+                if isinstance(node, ast.Call):
+                    if self._recorder_call(ctx, node) is not None:
+                        recorders.append(node)
+                    elif self._clock_call(ctx, node) is not None:
+                        clock_calls.append(node)
+                elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ) and self._clock_call(ctx, node.value) is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            clock_names[tgt.id] = node.value
+            for call in recorders:
+                what = self._recorder_call(ctx, call)
+                yield ctx.finding(
+                    self.name, call,
+                    f"{what} inside a traced body records ONCE at "
+                    "trace time and never again — the compiled program "
+                    "carries no host callback; record on the host "
+                    "around the dispatch (executor stage timing, "
+                    "mutation ack path) instead",
+                )
+            # clock reads that feed a recorder: directly as an
+            # argument, or through an assigned name referenced in any
+            # recorder call's arguments
+            fed: Set[int] = set()
+            arg_names: Set[str] = set()
+            for rec in recorders:
+                for arg in list(rec.args) + [
+                    kw.value for kw in rec.keywords
+                ]:
+                    arg_names |= self._names_in(arg)
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Call) and \
+                                self._clock_call(ctx, sub) is not None:
+                            fed.add(id(sub))
+            for name, call in clock_names.items():
+                if name in arg_names:
+                    fed.add(id(call))
+            emitted: Set[int] = set()   # a call can sit in clock_calls
+            for call in clock_calls + list(clock_names.values()):
+                # AND clock_names (ast.walk visits the Assign and its
+                # value Call separately) — one finding per read
+                if id(call) not in fed or id(call) in emitted:
+                    continue
+                emitted.add(id(call))
+                d = self._clock_call(ctx, call)
+                yield ctx.finding(
+                    self.name, call,
+                    f"{d}() inside a traced body is a TRACE-TIME "
+                    "constant — the duration it feeds into a metric "
+                    "recorder measures the trace, not the dispatch; "
+                    "stamp on the host before/after the dispatch call",
+                )
+
+
+RULES = [MetricsInTracedBodyRule()]

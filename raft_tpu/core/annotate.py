@@ -5,17 +5,55 @@ common/detail/nvtx.hpp:23-206 (RAII ``nvtx::range``, push_range/pop_range,
 per-domain colored ranges, compiled out when NVTX disabled). The TPU analog
 uses ``jax.profiler``: ``TraceAnnotation`` shows up on the XLA trace viewer
 timeline and ``jax.named_scope`` tags HLO ops so ranges survive into compiled
-profiles. Disabled (near-zero cost) unless profiling is active.
+profiles.
+
+Like the reference's ``NVTX_ENABLED`` compile-out, ranges honor a GLOBAL
+enable flag: when profiling is off (the default — set ``RAFT_TPU_PROFILE=1``
+to force it on), :func:`annotate` and :func:`push_range` are TRUE no-ops —
+no ``TraceAnnotation``, no ``ExitStack``, no stack append — so the hot
+serving path pays one module-attribute load per range
+(tests/test_obs.py pins the no-allocation claim). :func:`start_trace`
+flips the flag on for the duration of a capture (and :func:`stop_trace`
+restores it), so an SLO-triggered capture
+(:class:`raft_tpu.obs.ProfileTrigger`) sees every range without anyone
+paying for them between captures.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Iterator, List
 
 import jax
 
+from raft_tpu.core import logger
+
+# the global range-enable gate (the NVTX_ENABLED analog): a list cell so
+# every reader shares it by reference. Default off — ranges cost nothing
+# until a trace capture (or RAFT_TPU_PROFILE=1) wants them.
+_ENV_DEFAULT: bool = (
+    os.environ.get("RAFT_TPU_PROFILE", "").strip().lower()
+    in ("1", "on", "true", "yes")
+)
+_ENABLED: List[bool] = [_ENV_DEFAULT]
 _stack: List[contextlib.ExitStack] = []
+# profiling state before start_trace flipped it, restored by stop_trace
+_pre_trace: List[bool] = []
+
+
+def profiling_enabled() -> bool:
+    """Are ranges currently being emitted?"""
+    return _ENABLED[0]
+
+
+def set_profiling(on: bool) -> bool:
+    """Flip the global range gate; returns the PREVIOUS state. Ranges
+    pushed while disabled are not tracked — a ``pop_range`` crossing an
+    enable flip logs instead of popping someone else's range."""
+    prev = _ENABLED[0]
+    _ENABLED[0] = bool(on)
+    return prev
 
 
 @contextlib.contextmanager
@@ -24,14 +62,21 @@ def annotate(name: str, *args) -> Iterator[None]:
 
     ``args`` are %-formatted into ``name`` like the reference's printf-style
     range names (nvtx.hpp:54 ``range(const char* format, Args... args)``).
+    A no-op (no profiler objects constructed) while profiling is off.
     """
+    if not _ENABLED[0]:
+        yield
+        return
     label = name % args if args else name
     with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
         yield
 
 
 def push_range(name: str, *args) -> None:
-    """Imperative begin (reference nvtx.hpp push_range)."""
+    """Imperative begin (reference nvtx.hpp push_range). A true no-op —
+    nothing allocated, nothing stacked — while profiling is off."""
+    if not _ENABLED[0]:
+        return
     label = name % args if args else name
     es = contextlib.ExitStack()
     es.enter_context(jax.profiler.TraceAnnotation(label))
@@ -39,15 +84,38 @@ def push_range(name: str, *args) -> None:
 
 
 def pop_range() -> None:
-    """Imperative end (reference nvtx.hpp pop_range)."""
+    """Imperative end (reference nvtx.hpp pop_range). Popping an empty
+    stack — an unbalanced pop, or ranges pushed while profiling was
+    disabled — is a LOUD no-op (debug log), never an exception: range
+    bookkeeping must not take down the path it annotates."""
     if _stack:
         _stack.pop().close()
+    else:
+        logger.debug(
+            "pop_range: range stack empty (unbalanced pop, or the "
+            "matching push_range ran while profiling was disabled)"
+        )
 
 
 def start_trace(log_dir: str) -> None:
-    """Start an XLA profiler trace capture (output viewable in TensorBoard)."""
+    """Start an XLA profiler trace capture (output viewable in
+    TensorBoard) and enable range emission for its duration. The
+    profiler starts FIRST: if it refuses (a capture is already
+    running), the range gate and its restore stack are untouched — a
+    failed start must not leave every later range permanently paid
+    for."""
     jax.profiler.start_trace(log_dir)
+    _pre_trace.append(set_profiling(True))
 
 
 def stop_trace() -> None:
-    jax.profiler.stop_trace()
+    """Stop the capture and restore the range gate to its pre-capture
+    state (an explicitly-enabled process stays enabled). An UNBALANCED
+    stop — a capture someone started through ``jax.profiler`` directly
+    — falls back to the env-derived default, never a hard False: a
+    ``RAFT_TPU_PROFILE=1`` process must not be silently disabled by
+    one stray stop."""
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        set_profiling(_pre_trace.pop() if _pre_trace else _ENV_DEFAULT)

@@ -1,0 +1,58 @@
+"""Runtime observability for the serving tier: metrics, the request
+flight recorder, and SLO-triggered profile capture.
+
+Three layers, cheapest first (docs/observability.md):
+
+* :mod:`raft_tpu.obs.metrics` — the process-wide
+  :class:`MetricRegistry` of counters, gauges, and log2 latency
+  histograms (streaming p50/p95/p99 at any instant), with Prometheus
+  exposition and a periodic JSONL emitter. The serving executor,
+  admission controller, mutation ops, and health/failover trackers all
+  record here by default; ``RAFT_TPU_OBS=off`` turns every recorder
+  into a no-op.
+* :mod:`raft_tpu.obs.flight` — the bounded ring-buffer
+  :class:`FlightRecorder` of per-request span events
+  (submit→pack→dispatch→hedge→demux), dumped as JSONL on failure
+  paths — the postmortem story.
+* :mod:`raft_tpu.obs.capture` — :class:`ProfileTrigger`: watch a
+  latency histogram's windowed tail quantile and fire ONE bounded
+  ``jax.profiler`` capture when the SLO breaches for N consecutive
+  windows.
+"""
+
+from raft_tpu.obs.flight import FlightRecorder
+from raft_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    default_registry,
+    enabled,
+    program_census,
+    set_enabled,
+)
+
+
+def __getattr__(name):
+    # ProfileTrigger lazily: capture.py imports jax (via
+    # core.annotate), and the metrics/flight layers must stay
+    # importable from mesh-free control planes (resilience/replica.py)
+    # without paying for it
+    if name == "ProfileTrigger":
+        from raft_tpu.obs.capture import ProfileTrigger
+
+        return ProfileTrigger
+    raise AttributeError(f"module 'raft_tpu.obs' has no attribute {name!r}")
+
+__all__ = [
+    "MetricRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "FlightRecorder",
+    "ProfileTrigger",
+    "default_registry",
+    "enabled",
+    "set_enabled",
+    "program_census",
+]

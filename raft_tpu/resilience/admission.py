@@ -76,6 +76,7 @@ import time
 from typing import Callable, Optional
 
 from raft_tpu import errors
+from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.resilience.deadline import Deadline
 
 __all__ = ["AdmissionController", "AdmissionStats"]
@@ -128,13 +129,20 @@ class AdmissionController:
     service time has been measured (None = omit the estimate).
     ``clock``: monotonic-seconds source, injectable for deterministic
     token-limiter tests.
+    ``registry`` / ``name``: where the live shed/occupancy series
+    (``admission_shed_total{controller=name, reason}``, the
+    queue/in-flight/service-EWMA gauges) record — default the
+    process-wide :func:`raft_tpu.obs.default_registry`
+    (docs/observability.md; ``RAFT_TPU_OBS=off`` no-ops them).
     """
 
     def __init__(self, *, max_concurrent: int = 1, max_queue: int = 0,
                  rate: Optional[float] = None,
                  burst: Optional[int] = None,
                  retry_after_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: "obs_metrics.MetricRegistry | None" = None,
+                 name: str = "admission"):
         errors.expects(
             max_concurrent >= 1,
             "AdmissionController: max_concurrent=%d < 1", max_concurrent,
@@ -175,6 +183,27 @@ class AdmissionController:
         # token bucket state (continuous refill at `rate`/s up to burst)
         self._tokens = float(self.burst or 0)
         self._token_stamp = clock()
+        # live shed/occupancy metrics (ISSUE 13, docs/observability.md):
+        # the same counters stats() snapshots, but readable by a scrape
+        # while the overload is HAPPENING. Handles cached here; every
+        # recorder honors the RAFT_TPU_OBS gate.
+        reg = (obs_metrics.default_registry() if registry is None
+               else registry)
+        self.name = name
+        self._m_shed = {
+            "queue": reg.counter("admission_shed_total",
+                                 controller=name, reason="queue"),
+            "rate": reg.counter("admission_shed_total",
+                                controller=name, reason="rate"),
+        }
+        self._m_timeout = reg.counter("admission_timeouts_total",
+                                      controller=name)
+        self._g_queue = reg.gauge("admission_queue_depth",
+                                  controller=name)
+        self._g_inflight = reg.gauge("admission_in_flight",
+                                     controller=name)
+        self._g_service = reg.gauge("admission_service_ewma_ms",
+                                    controller=name)
 
     # -- observability -------------------------------------------------------
     def stats(self) -> AdmissionStats:
@@ -238,6 +267,12 @@ class AdmissionController:
             priced = max(priced, self.retry_after_s)
         return priced
 
+    def _sync_gauges(self) -> None:
+        """Mirror the two depth gauges into the registry (lock held by
+        the caller; gauge locks are leaves, no ordering hazard)."""
+        self._g_queue.set(self._queue_depth)
+        self._g_inflight.set(self._in_flight)
+
     def _refill_tokens(self, now: float) -> None:
         self._tokens = min(
             float(self.burst),
@@ -269,6 +304,7 @@ class AdmissionController:
                 and self._queue_depth >= self.max_queue
             ):
                 self._shed_queue += 1
+                self._m_shed["queue"].inc()
                 raise errors.RaftOverloadError(
                     f"admission queue full ({self._queue_depth} waiting, "
                     f"{self._in_flight} in flight; max_queue="
@@ -279,6 +315,7 @@ class AdmissionController:
                 self._refill_tokens(self._clock())
                 if self._tokens < 1.0:
                     self._shed_rate += 1
+                    self._m_shed["rate"].inc()
                     raise errors.RaftOverloadError(
                         f"rate limit exhausted ({self.rate}/s, burst "
                         f"{self.burst})",
@@ -287,6 +324,7 @@ class AdmissionController:
                 self._tokens -= 1.0
             self._queue_depth += 1
             self._peak_queue = max(self._peak_queue, self._queue_depth)
+            self._sync_gauges()
             wait_until = (
                 None if timeout_s is None
                 else time.monotonic() + timeout_s
@@ -299,6 +337,7 @@ class AdmissionController:
                     )
                     if wait is not None and wait <= 0:
                         self._timed_out += 1
+                        self._m_timeout.inc()
                         raise errors.RaftTimeoutError(
                             "admission wait expired after "
                             f"{timeout_s:.3g}s ({self._queue_depth - 1} "
@@ -307,6 +346,11 @@ class AdmissionController:
                     self._slot_free.wait(wait)
             finally:
                 self._queue_depth -= 1
+                # re-sync HERE, not only in _begin_locked: the timeout
+                # path leaves through the exception, and a stale depth
+                # gauge during sustained overload is exactly when the
+                # gauge matters (review-caught r13)
+                self._sync_gauges()
             ticket = self._begin_locked(1)
         try:
             yield self
@@ -322,6 +366,7 @@ class AdmissionController:
         ticket = self._next_ticket
         self._next_ticket += 1
         self._inflight_started[ticket] = (self._clock(), n)
+        self._sync_gauges()
         return ticket
 
     def enqueue(self, n: int = 1) -> None:
@@ -348,6 +393,7 @@ class AdmissionController:
             cap = self.max_queue + self.max_concurrent
             if self._queue_depth + self._in_flight + n > cap:
                 self._shed_queue += n
+                self._m_shed["queue"].inc(n)
                 raise errors.RaftOverloadError(
                     f"admission capacity full ({self._queue_depth} "
                     f"waiting + {self._in_flight} in flight >= "
@@ -359,6 +405,7 @@ class AdmissionController:
                 self._refill_tokens(self._clock())
                 if self._tokens < float(n):
                     self._shed_rate += n
+                    self._m_shed["rate"].inc(n)
                     raise errors.RaftOverloadError(
                         f"rate limit exhausted ({self.rate}/s, burst "
                         f"{self.burst})",
@@ -367,6 +414,7 @@ class AdmissionController:
                 self._tokens -= float(n)
             self._queue_depth += n
             self._peak_queue = max(self._peak_queue, self._queue_depth)
+            self._sync_gauges()
 
     def begin_service(self, n: int = 1) -> int:
         """Report ``n`` queued requests dispatched (queue → in service).
@@ -397,6 +445,8 @@ class AdmissionController:
                 held if self._service_ewma_s is None
                 else 0.8 * self._service_ewma_s + 0.2 * held
             )
+            self._g_service.set(self._service_ewma_s * 1e3)
+            self._sync_gauges()
             self._slot_free.notify(n)
 
     def abort_service(self, ticket: int) -> None:
@@ -409,6 +459,7 @@ class AdmissionController:
         with self._lock:
             _t0, n = self._inflight_started.pop(ticket)
             self._in_flight -= n
+            self._sync_gauges()
             self._slot_free.notify(n)
 
     def cancel_queued(self, n: int = 1) -> None:
@@ -416,6 +467,7 @@ class AdmissionController:
         shutdown, a caller abandoning its queued request)."""
         with self._lock:
             self._queue_depth -= min(n, self._queue_depth)
+            self._sync_gauges()
 
     def __repr__(self) -> str:
         s = self.stats()

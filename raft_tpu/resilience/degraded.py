@@ -89,7 +89,11 @@ def resolve_shard_mask(shard_mask: Any, n_ranks: int) -> np.ndarray:
     if shard_mask is True:
         return np.ones(n_ranks, np.int32)
     if isinstance(shard_mask, HealthReport):
-        shard_mask = ShardHealth(n_ranks).apply_report(shard_mask)
+        # telemetry=False: this tracker lives for one normalization —
+        # it must not reset the global ranks-up gauge or count fake
+        # flip transitions on every search call
+        shard_mask = ShardHealth(
+            n_ranks, telemetry=False).apply_report(shard_mask)
     if isinstance(shard_mask, ShardHealth):
         arr = shard_mask.mask()
     else:

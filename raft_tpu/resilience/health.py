@@ -29,8 +29,22 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from raft_tpu import errors
+from raft_tpu.obs import metrics as obs_metrics
 
 __all__ = ["ShardHealth", "HealthProbe", "HealthReport", "health_check"]
+
+# health-transition telemetry (ISSUE 13, docs/observability.md): every
+# ACTUAL up/down flip counts (idempotent re-marks do not), and the
+# up-rank gauge tracks the most recently flipped tracker — the
+# failover-flip signal an alert watches next to
+# ``failover_rerouted_shards`` (resilience/replica.py)
+_reg = obs_metrics.default_registry()
+_M_FLIPS = {
+    "down": _reg.counter("health_transitions_total", direction="down"),
+    "up": _reg.counter("health_transitions_total", direction="up"),
+}
+_G_RANKS_UP = _reg.gauge("health_ranks_up")
+del _reg
 
 
 class ShardHealth:
@@ -41,10 +55,22 @@ class ShardHealth:
     searches take as their ``shard_mask`` runtime input.
     """
 
-    def __init__(self, n_ranks: int):
+    def __init__(self, n_ranks: int, *, telemetry: bool = True):
         errors.expects(n_ranks >= 1, "ShardHealth: n_ranks=%d < 1", n_ranks)
         self._lock = threading.Lock()
         self._up = np.ones(n_ranks, dtype=bool)
+        # `telemetry=False` is for THROWAWAY trackers (the
+        # resolve_shard_mask HealthReport normalization builds one per
+        # search call): only a long-lived tracker may drive the global
+        # flip counters/gauge, or steady degraded traffic would count
+        # one fake "flip" per call and whipsaw the gauge
+        # (review-caught r13)
+        self._telemetry = bool(telemetry)
+        if self._telemetry:
+            # seed the gauge at construction: a fresh tracker is
+            # all-up, and a scrape before the first flip must not read
+            # the gauge's 0.0 initial value as a total outage
+            _G_RANKS_UP.set(n_ranks)
 
     @property
     def n_ranks(self) -> int:
@@ -61,13 +87,25 @@ class ShardHealth:
         """Record an external down signal for ``rank`` (idempotent)."""
         self._check_rank(rank)
         with self._lock:
+            flipped = bool(self._up[rank])
             self._up[rank] = False
+            if flipped and self._telemetry:
+                # gauge write INSIDE the lock: two concurrent flips
+                # must apply their counts in flip order, or the gauge
+                # holds the stale value until the next transition
+                # (gauge locks are leaves — no ordering hazard)
+                _M_FLIPS["down"].inc()
+                _G_RANKS_UP.set(int(self._up.sum()))
 
     def mark_up(self, rank: int) -> None:
         """Record recovery of ``rank`` (idempotent)."""
         self._check_rank(rank)
         with self._lock:
+            flipped = not bool(self._up[rank])
             self._up[rank] = True
+            if flipped and self._telemetry:
+                _M_FLIPS["up"].inc()
+                _G_RANKS_UP.set(int(self._up.sum()))
 
     def is_up(self, rank: int) -> bool:
         self._check_rank(rank)

@@ -39,8 +39,20 @@ from typing import Any, Tuple
 import numpy as np
 
 from raft_tpu import errors
+from raft_tpu.obs import metrics as obs_metrics
 
 __all__ = ["ReplicaPlacement", "FailoverPlan", "resolve_route"]
+
+# failover-routing telemetry (ISSUE 13, docs/observability.md): every
+# plan built counts, and the two gauges show the CURRENT routing
+# posture — shards served off-primary (a flip in effect) and shards
+# with no live holder (coverage loss). Paired with
+# ``health_transitions_total`` these narrate a failure end to end.
+_reg = obs_metrics.default_registry()
+_M_PLANS = _reg.counter("failover_plans_total")
+_G_REROUTED = _reg.gauge("failover_rerouted_shards")
+_G_UNSERVED = _reg.gauge("failover_unserved_shards")
+del _reg
 
 
 @dataclasses.dataclass(frozen=True)
@@ -244,6 +256,9 @@ class FailoverPlan:
                 if alive[r]:
                     route[s] = j
                     break
+        _M_PLANS.inc()
+        _G_REROUTED.set(int((route > 0).sum()))
+        _G_UNSERVED.set(int((route < 0).sum()))
         return cls(placement=placement, route=route)
 
     @classmethod

@@ -89,6 +89,10 @@ class PendingRequest:
     future: object             # concurrent.futures.Future
     t_arrival: float           # executor-clock stamp (flush deadline)
     ticket: Optional[object] = None   # opaque admission bookkeeping
+    # the flight-recorder correlation id (raft_tpu.obs.flight): the
+    # executor stamps a per-process sequence at submit so one request's
+    # span events (submit→pack→dispatch→hedge→demux) join up
+    req_id: int = -1
 
     @property
     def n_rows(self) -> int:
@@ -103,6 +107,7 @@ class MicroBatch:
     queries: np.ndarray                      # (bucket, d) float32
     entries: List[Tuple[PendingRequest, int]]  # (request, start row)
     n_valid: int                             # valid rows; rest is padding
+    batch_id: int = -1                       # flight-recorder correlation
 
     @property
     def bucket(self) -> int:

@@ -58,6 +58,8 @@ import numpy as np
 
 from raft_tpu import compat, errors
 from raft_tpu.core.interruptible import Interruptible
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs.flight import FlightRecorder
 from raft_tpu.resilience.admission import AdmissionController
 from raft_tpu.resilience.deadline import HedgePolicy
 from raft_tpu.serving.batching import (
@@ -67,7 +69,18 @@ from raft_tpu.serving.batching import (
     pack_requests,
 )
 
-__all__ = ["ServingExecutor", "ExecutorStats"]
+__all__ = ["ServingExecutor", "ExecutorStats", "STAGES"]
+
+# the serving pipeline's named stages, in hop order — each is a
+# ``serving_stage_ms{executor,stage,bucket}`` histogram recorded from
+# timestamps the executor already takes (docs/observability.md "Stage
+# timing"): queue_wait (submit → packed), batch_build (pack + pad),
+# staging (host→device put), dispatch_ready (dispatch → drain-loop
+# readiness — the polling gives it for free, no block_until_ready),
+# demux (host conversion + per-request slicing), e2e (submit → future
+# resolved; the SLO-trigger input)
+STAGES = ("queue_wait", "batch_build", "staging", "dispatch_ready",
+          "demux", "e2e")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +99,15 @@ class ExecutorStats:
     backup_wins: int          # hedged batches the backup answered first
     pending: int              # gauge: requests waiting to be batched
     in_flight: int            # gauge: batches dispatched, not demuxed
+    # histogram-derived per-stage latency quantiles (ISSUE 13): stage
+    # name -> milliseconds, pooled across this executor's buckets via
+    # the registry's log2 histograms. Appended with defaults so every
+    # pre-r13 positional construction and field read stays valid —
+    # nothing deprecated, nothing moved.
+    stage_p50_ms: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    stage_p99_ms: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def pad_fraction(self) -> float:
@@ -161,6 +183,16 @@ class ServingExecutor:
     with its dispatch closure; the executor always re-stages hedged
     batches from the host copy, so donation inside ``dispatch`` is
     safe.
+
+    ``registry`` — the :class:`~raft_tpu.obs.MetricRegistry` the
+    per-stage latency histograms (:data:`STAGES`), hedge counters, and
+    the coverage gauge record into (default: the process-wide
+    registry; ``RAFT_TPU_OBS=off`` no-ops every recorder).
+    ``flight`` — an optional :class:`~raft_tpu.obs.FlightRecorder`;
+    when given, every request's span (submit→pack→dispatch→hedge→
+    demux) is traced by id and the ring is auto-dumped as JSONL when a
+    batch fails or ``close()`` finds failures outstanding
+    (docs/observability.md "Flight recorder").
     """
 
     def __init__(
@@ -178,6 +210,8 @@ class ServingExecutor:
         stage: Callable[[np.ndarray], Any] = jax.device_put,
         clock: Callable[[], float] = time.monotonic,
         name: str = "serving",
+        registry: "obs_metrics.MetricRegistry | None" = None,
+        flight: Optional[FlightRecorder] = None,
     ):
         errors.expects(dim >= 1, "ServingExecutor: dim=%d < 1", dim)
         errors.expects(
@@ -207,6 +241,29 @@ class ServingExecutor:
         self._stage = stage
         self._clock = clock
         self.name = name
+        # observability (ISSUE 13, docs/observability.md): per-stage
+        # log2 latency histograms keyed (stage, bucket) — handles are
+        # cached here so the hot path never touches the registry lock —
+        # plus the optional flight recorder tracing request ids through
+        # every hop. All recording honors the RAFT_TPU_OBS gate.
+        self._registry = (obs_metrics.default_registry()
+                          if registry is None else registry)
+        self.flight = flight
+        self._stage_hist: Dict[tuple, obs_metrics.Histogram] = {}
+        self._c_completed = self._registry.counter(
+            "serving_requests_total", executor=name, outcome="completed")
+        self._c_failed = self._registry.counter(
+            "serving_requests_total", executor=name, outcome="failed")
+        self._c_hedges = self._registry.counter(
+            "serving_hedges_total", executor=name)
+        self._c_backup_wins = self._registry.counter(
+            "serving_backup_wins_total", executor=name)
+        # created on FIRST coverage sighting: a single-chip executor
+        # never demuxes a PartialSearchResult, and a coverage gauge
+        # stuck at its 0.0 initial value would read as total loss
+        self._g_coverage: Optional[obs_metrics.Gauge] = None
+        self._req_seq = 0
+        self._batch_seq = 0
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)       # batcher wake
@@ -265,7 +322,12 @@ class ServingExecutor:
             q.shape[0], self.buckets.largest,
         )
         if self.admission is not None:
-            self.admission.enqueue()       # may shed: RaftOverloadError
+            try:
+                self.admission.enqueue()   # may shed: RaftOverloadError
+            except errors.RaftOverloadError:
+                if self.flight is not None:
+                    self.flight.record("shed", rows=int(q.shape[0]))
+                raise
         fut: Future = Future()
         req = PendingRequest(queries=q, future=fut,
                              t_arrival=self._clock())
@@ -274,6 +336,15 @@ class ServingExecutor:
                 if self.admission is not None:
                     self.admission.cancel_queued()
                 errors.fail("submit on a closed ServingExecutor")
+            req.req_id = self._req_seq
+            self._req_seq += 1
+            if self.flight is not None:
+                # record BEFORE the batcher can see the request: a
+                # 'pack' preceding its own 'submit' in the ring would
+                # invert causality in the postmortem artifact (the
+                # recorder lock is a leaf — no ordering hazard)
+                self.flight.record("submit", request_id=req.req_id,
+                                   rows=int(q.shape[0]))
             self._pending.append(req)
             self._submitted += 1
             self._work.notify()
@@ -291,8 +362,52 @@ class ServingExecutor:
                     self._runtime.pop(key, None)
                 else:
                     self._runtime[key] = val
+        if self.flight is not None:
+            # the failover-flip postmortem breadcrumb: a FailoverPlan's
+            # route array is tiny and names exactly which replica copy
+            # serves each shard from here on
+            fields: Dict[str, Any] = {"keys": sorted(updates)}
+            for key, val in updates.items():
+                route = getattr(val, "route", None)
+                if route is not None:
+                    # a (P,) host routing array at flip time — not the
+                    # per-batch hot path
+                    fields[f"{key}_route"] = (
+                        np.asarray(route).tolist())  # jaxlint: disable=sync-in-hot-path
+            self.flight.record("runtime_update", **fields)
+
+    def _hist(self, stage_name: str, bucket: int) -> obs_metrics.Histogram:
+        """The (stage, bucket) latency histogram, registry-created once
+        and cached on this executor (the hot path's one-dict-lookup)."""
+        key = (stage_name, bucket)
+        h = self._stage_hist.get(key)
+        if h is None:
+            h = self._registry.histogram(
+                "serving_stage_ms", executor=self.name,
+                stage=stage_name, bucket=bucket,
+            )
+            self._stage_hist[key] = h
+        return h
+
+    def stage_quantile(self, stage_name: str, q: float,
+                       ) -> Optional[float]:
+        """One stage's latency quantile in ms, pooled across buckets
+        (None before any observation) — what :meth:`stats` reads."""
+        # snapshot first: the batcher/drain threads insert new bucket
+        # keys concurrently and dict iteration must not see the resize
+        hists = [h for (s, _b), h in list(self._stage_hist.items())
+                 if s == stage_name]
+        return obs_metrics.merged_quantile(hists, q)
 
     def stats(self) -> ExecutorStats:
+        p50: Dict[str, float] = {}
+        p99: Dict[str, float] = {}
+        for stage_name in STAGES:
+            v50 = self.stage_quantile(stage_name, 50.0)
+            if v50 is None:
+                continue
+            p50[stage_name] = v50
+            p99[stage_name] = self.stage_quantile(stage_name, 99.0)
         with self._lock:
             return ExecutorStats(
                 submitted=self._submitted,
@@ -307,6 +422,8 @@ class ServingExecutor:
                 backup_wins=self._backup_wins,
                 pending=len(self._pending),
                 in_flight=len(self._inflight),
+                stage_p50_ms=p50,
+                stage_p99_ms=p99,
             )
 
     def close(self, timeout_s: float = 30.0) -> None:
@@ -318,6 +435,17 @@ class ServingExecutor:
             self._done.notify_all()
         self._batcher.join(timeout_s)
         self._drainer.join(timeout_s)
+        if self.flight is not None:
+            with self._lock:
+                failed = self._failed
+            if failed:
+                # shutdown with failures outstanding: the third
+                # automatic dump trigger (docs/observability.md)
+                self.flight.record("close", failed=failed)
+                try:
+                    self.flight.dump("close-with-failures")
+                except Exception:   # noqa: BLE001 — close() must
+                    pass            # complete even when the sink can't
 
     def __enter__(self) -> "ServingExecutor":
         return self
@@ -351,13 +479,30 @@ class ServingExecutor:
                 if self._closed and not self._pending:
                     break
                 rows = sum(r.n_rows for r in self._pending)
+                t_pack0 = self._clock()
                 batch, self._pending = pack_requests(
                     self._pending, self.buckets, self.dim
                 )
                 if batch is None:      # unreachable via submit; be safe
                     continue
+                batch.batch_id = self._batch_seq
+                self._batch_seq += 1
                 runtime = dict(self._runtime)
                 full = batch.n_padded == 0 and rows >= batch.bucket
+            # stage metrics from stamps this loop already holds: the
+            # pack wall time, and each packed request's queue wait
+            now = self._clock()
+            self._hist("batch_build", batch.bucket).observe(
+                (now - t_pack0) * 1e3)
+            qw = self._hist("queue_wait", batch.bucket)
+            for req, start in batch.entries:
+                qw.observe((now - req.t_arrival) * 1e3)
+                if self.flight is not None:
+                    self.flight.record(
+                        "pack", request_id=req.req_id,
+                        batch_id=batch.batch_id, bucket=batch.bucket,
+                        start=start,
+                    )
             self._dispatch_batch(batch, runtime, full)
         with self._done:
             self._batcher_exited = True
@@ -380,9 +525,21 @@ class ServingExecutor:
             # async against earlier batches still computing — this IS
             # the double buffer (donate-friendly: hedges re-stage from
             # batch.queries, never reuse this device buffer)
+            t_s0 = self._clock()
             staged = self._stage(batch.queries)
             t0 = self._clock()
             out = self._dispatch(staged, **runtime)
+            # staging is the host-side cost of the device_put call —
+            # the transfer itself overlaps compute (that's the point);
+            # a blocking stage override shows up here
+            self._hist("staging", batch.bucket).observe(
+                (t0 - t_s0) * 1e3)
+            if self.flight is not None:
+                self.flight.record(
+                    "dispatch", batch_id=batch.batch_id,
+                    bucket=batch.bucket, n_requests=batch.n_requests,
+                    requests=[r.req_id for r, _ in batch.entries],
+                )
         except Exception as exc:   # noqa: BLE001 — fail THIS batch only
             if ticket is not None:
                 # abort, not finish: a crashed dispatch must not feed
@@ -427,7 +584,12 @@ class ServingExecutor:
             backup = self._backup(
                 self._stage(fl.batch.queries), **fl.runtime
             )
-        except Exception:   # noqa: BLE001 — primary still owes the answer
+        except Exception as exc:   # noqa: BLE001 — primary still owes
+            if self.flight is not None:        # the answer
+                self.flight.record(
+                    "hedge_fail", batch_id=fl.batch.batch_id,
+                    error=type(exc).__name__,
+                )
             return
         # mark hedged only on a SUCCESSFUL backup dispatch: the flag
         # drives the primary_wins/backup_wins accounting in _finish,
@@ -436,6 +598,14 @@ class ServingExecutor:
         fl.candidates.append(backup)
         with self._lock:
             self._hedged_batches += 1
+        self._c_hedges.inc()
+        if self.flight is not None:
+            # this event NAMES the straggler: the batch that sat
+            # unready past the hedge delay, and for how long
+            self.flight.record(
+                "hedge", batch_id=fl.batch.batch_id,
+                age_ms=round((now - fl.t_dispatch) * 1e3, 3),
+            )
         if isinstance(self.hedge, HedgePolicy):
             with self.hedge._lock:
                 self.hedge.hedges += 1
@@ -488,6 +658,10 @@ class ServingExecutor:
         if fl.ticket is not None:
             self.admission.finish_service(fl.ticket)
         held = self._clock() - fl.t_dispatch
+        bucket = fl.batch.bucket
+        # dispatch→ready straight from the drain loop's own readiness
+        # polling — the stamp pair already existed, no new sync
+        self._hist("dispatch_ready", bucket).observe(held * 1e3)
         backup_won = fl.hedged and len(fl.candidates) > 1 \
             and winner is fl.candidates[1]
         if isinstance(self.hedge, HedgePolicy):
@@ -504,6 +678,7 @@ class ServingExecutor:
         while hasattr(winner, "is_ready") and hasattr(winner, "value") \
                 and not hasattr(winner, "shape"):
             winner = winner.value
+        t_demux0 = self._clock()
         try:
             # the ONE intentional host sync of the serving path: the
             # winner is already ready, this is the demux conversion
@@ -511,7 +686,20 @@ class ServingExecutor:
         except Exception as exc:   # noqa: BLE001
             self._fail_batch(fl.batch, exc)
             return
-        bucket = fl.batch.bucket
+        # mnmg coverage, read off the ALREADY-converted host result (a
+        # PartialSearchResult-shaped pytree carries .coverage) — the
+        # degraded-serving gauge, no extra sync
+        cov = getattr(host, "coverage", None)
+        if cov is not None:
+            try:
+                cov_min = float(np.min(cov))
+            except (TypeError, ValueError):
+                cov_min = None
+            if cov_min is not None:
+                if self._g_coverage is None:
+                    self._g_coverage = self._registry.gauge(
+                        "serving_coverage_min", executor=self.name)
+                self._g_coverage.set(cov_min)
         delivered = 0
         for req, start in fl.batch.entries:
             if req.future.done():     # caller cancelled while queued
@@ -529,16 +717,50 @@ class ServingExecutor:
             except InvalidStateError:
                 continue              # cancel raced the done() check
             delivered += 1
+        now = self._clock()
+        self._hist("demux", bucket).observe((now - t_demux0) * 1e3)
+        e2e = self._hist("e2e", bucket)
+        for req, _start in fl.batch.entries:
+            e2e.observe((now - req.t_arrival) * 1e3)
+        self._c_completed.inc(delivered)
+        if backup_won:
+            self._c_backup_wins.inc()
+        if self.flight is not None:
+            self.flight.record(
+                "demux", batch_id=fl.batch.batch_id,
+                winner=("backup" if backup_won
+                        else "primary" if fl.hedged else "unhedged"),
+                held_ms=round(held * 1e3, 3), delivered=delivered,
+            )
         with self._lock:
             self._completed += delivered
             self._backup_wins += int(backup_won)
 
     def _fail_batch(self, batch: MicroBatch, exc: BaseException) -> None:
+        if self.flight is not None:
+            # the postmortem path: record the failure, then dump the
+            # ring BEFORE resolving futures — the file shows what the
+            # doomed batch looked like when it died (deadline trips
+            # arrive here too: a timed-out dispatch raises)
+            self.flight.record(
+                "batch_fail", batch_id=batch.batch_id,
+                bucket=batch.bucket, error=type(exc).__name__,
+                message=str(exc)[:200],
+                requests=[r.req_id for r, _ in batch.entries],
+            )
+            try:
+                self.flight.dump("batch-fail")
+            except Exception:   # noqa: BLE001 — a failed DUMP (bad
+                pass            # dir, disk full) must not escape this
+                                # handler: the futures below still owe
+                                # their callers the real exception, and
+                                # an escape would kill the worker thread
         for req, _ in batch.entries:
             if not req.future.done():
                 try:
                     req.future.set_exception(exc)
                 except InvalidStateError:
                     pass              # cancel raced the done() check
+        self._c_failed.inc(batch.n_requests)
         with self._lock:
             self._failed += batch.n_requests

@@ -44,6 +44,7 @@ import dataclasses
 import functools
 import json
 import threading
+import time
 import typing
 
 import jax
@@ -51,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import compat, errors
+from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit, kmeans_predict
 from raft_tpu.spatial.ann.common import (
     ListStorage,
@@ -91,6 +93,53 @@ __all__ = [
     "upsert",
     "wrap_mutable",
 ]
+
+
+# mutation-tier telemetry (ISSUE 13, docs/observability.md): host-wall
+# durations of the three ops (the upsert/delete stamps INCLUDE their ack
+# sync — that is the latency an ingest client sees) plus the delta-fill
+# / tombstone-pressure gauges compaction decisions read. Every series
+# carries an ``index=<MutableIndex.name>`` label — a process serving
+# several mutable indexes must not interleave their pressure gauges —
+# and handles are cached per name so the ack path pays one dict get.
+# RAFT_TPU_OBS=off no-ops them all.
+_mseries_cache: dict = {}
+_mseries_lock = threading.Lock()
+
+
+def _mseries(index_name: str) -> dict:
+    s = _mseries_cache.get(index_name)
+    if s is not None:
+        return s
+    reg = obs_metrics.default_registry()
+    with _mseries_lock:
+        if index_name not in _mseries_cache:
+            _mseries_cache[index_name] = {
+                "op_ms": {
+                    op: reg.histogram("mutation_op_ms",
+                                      index=index_name, op=op)
+                    for op in ("upsert", "delete", "compact")
+                },
+                "rows": {
+                    key: reg.counter("mutation_rows_total",
+                                     index=index_name, op=op, result=res)
+                    for key, (op, res) in {
+                        "accepted": ("upsert", "accepted"),
+                        "rejected": ("upsert", "rejected"),
+                        "deleted": ("delete", "found"),
+                        "missing": ("delete", "missing"),
+                    }.items()
+                },
+                "compactions": reg.counter("mutation_compactions_total",
+                                           index=index_name),
+                "fill": reg.gauge("mutation_delta_fill",
+                                  index=index_name),
+                "max_fill": reg.gauge("mutation_delta_max_fill",
+                                      index=index_name),
+                "tombstone": reg.gauge("mutation_tombstone_frac",
+                                       index=index_name),
+            }
+        return _mseries_cache[index_name]
 
 
 @compat.register_dataclass
@@ -140,6 +189,11 @@ class MutableIndex:
         # host-side incremental-checkpoint bookkeeping (lists whose
         # delta segment changed since the last checkpoint write)
         self.dirty_lists: set = set()
+        # host-side telemetry label (NOT serialized — a loaded
+        # checkpoint re-labels at wrap/load time): the ``index=`` label
+        # on every mutation_* series, so several mutable indexes in one
+        # process keep distinct pressure gauges (docs/observability.md)
+        self.name: str = "mutable"
 
     @property
     def n_lists(self) -> int:
@@ -155,14 +209,16 @@ class MutableIndex:
 
 
 def _with(mindex: MutableIndex, **kw) -> MutableIndex:
-    """dataclasses.replace that PRESERVES the host-side dirty set
-    (``__post_init__`` would reset it)."""
+    """dataclasses.replace that PRESERVES the host-side dirty set and
+    telemetry label (``__post_init__`` would reset them)."""
     out = dataclasses.replace(mindex, **kw)
     out.dirty_lists = set(mindex.dirty_lists)
+    out.name = mindex.name
     return out
 
 
-def wrap_mutable(index, *, delta_cap: int = 32) -> MutableIndex:
+def wrap_mutable(index, *, delta_cap: int = 32,
+                 name: str = "mutable") -> MutableIndex:
     """Wrap a frozen :class:`IVFFlatIndex` / :class:`IVFPQIndex` /
     :class:`IVFSQIndex` for online mutation. Host-side (one
     inverse-permutation pass over ``sorted_ids``); the wrapped index's
@@ -173,7 +229,12 @@ def wrap_mutable(index, *, delta_cap: int = 32) -> MutableIndex:
     ``delta_cap``: static per-list delta capacity. Upserts into a full
     segment are REJECTED (reported via the accepted mask) until
     compaction drains it — size it from the expected ingest rate between
-    compactions (docs/mutation.md "Capacity tuning")."""
+    compactions (docs/mutation.md "Capacity tuning").
+
+    ``name``: the ``index=`` label on this index's ``mutation_*``
+    telemetry series (docs/observability.md) — give each mutable index
+    in a process its own so their pressure gauges stay distinct. Host
+    state only; never serialized."""
     errors.expects(
         isinstance(index, (IVFFlatIndex, IVFPQIndex, IVFSQIndex)),
         "wrap_mutable: expected an IVFFlatIndex, IVFPQIndex, or "
@@ -207,12 +268,14 @@ def wrap_mutable(index, *, delta_cap: int = 32) -> MutableIndex:
         counts=jnp.zeros((nl,), jnp.int32),
         cap=int(delta_cap),
     )
-    return MutableIndex(
+    out = MutableIndex(
         index=index,
         delta=delta,
         row_mask=jnp.ones((n + 1,), jnp.int8),
         id_to_pos=jnp.asarray(id_to_pos),
     )
+    out.name = str(name)
+    return out
 
 
 # ------------------------------------------------------------- mutation ops
@@ -332,11 +395,17 @@ def upsert(mindex: MutableIndex, vectors, ids):
         "ids: expected shape (%d,), got %s", vecs.shape[0],
         tuple(idarr.shape),
     )
+    t0 = time.perf_counter()
     delta, row_mask, accepted, lbl, dirty_sup = _upsert_impl(
         mindex.index.centroids, mindex.delta, mindex.row_mask,
         mindex.id_to_pos, vecs, idarr,
     )
     accepted_np = np.asarray(accepted)
+    ms = _mseries(mindex.name)
+    ms["op_ms"]["upsert"].observe((time.perf_counter() - t0) * 1e3)
+    n_acc = int(accepted_np.sum())
+    ms["rows"]["accepted"].inc(n_acc)
+    ms["rows"]["rejected"].inc(int(accepted_np.size) - n_acc)
     out = _with(mindex, delta=delta, row_mask=row_mask)
     out.dirty_lists.update(np.asarray(lbl)[accepted_np].tolist())
     # a superseded delta copy dirties ITS list too — an incremental
@@ -354,12 +423,19 @@ def delete(mindex: MutableIndex, ids):
         idarr.ndim == 1, "ids: expected a 1-d batch, got shape %s",
         tuple(idarr.shape),
     )
+    t0 = time.perf_counter()
     delta, row_mask, found, dirty = _delete_impl(
         mindex.delta, mindex.row_mask, mindex.id_to_pos, idarr
     )
     out = _with(mindex, delta=delta, row_mask=row_mask)
     out.dirty_lists.update(np.nonzero(np.asarray(dirty))[0].tolist())
-    return out, np.asarray(found)
+    found_np = np.asarray(found)
+    ms = _mseries(mindex.name)
+    ms["op_ms"]["delete"].observe((time.perf_counter() - t0) * 1e3)
+    n_found = int(found_np.sum())
+    ms["rows"]["deleted"].inc(n_found)
+    ms["rows"]["missing"].inc(int(found_np.size) - n_found)
+    return out, found_np
 
 
 # --------------------------------------------------------------- search
@@ -560,7 +636,7 @@ def compaction_stats(mindex: MutableIndex) -> dict:
     n_real = max(int(real.sum()), 1)
     rm = np.asarray(mindex.row_mask)[: sids.shape[0]] > 0
     dead = int((real & ~rm).sum())
-    return {
+    out = {
         "delta_fill": float(counts.sum() / max(counts.size * delta.cap, 1)),
         "delta_max_fill": float(counts.max() / delta.cap)
         if counts.size else 0.0,
@@ -568,6 +644,14 @@ def compaction_stats(mindex: MutableIndex) -> dict:
         "tombstone_frac": dead / n_real,
         "main_rows": n_real,
     }
+    # the mutation-pressure gauges: every reader of these stats (the
+    # BackgroundCompactor cycle, an operator poll) refreshes the live
+    # values an alert can watch between compactions
+    ms = _mseries(mindex.name)
+    ms["fill"].set(out["delta_fill"])
+    ms["max_fill"].set(out["delta_max_fill"])
+    ms["tombstone"].set(out["tombstone_frac"])
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -672,6 +756,7 @@ def compact(
     programs (re-run :func:`mutable_warmup` before swapping the state in
     when they do change — :class:`BackgroundCompactor` leaves the old
     state serving until then)."""
+    t_compact0 = time.perf_counter()
     index = mindex.index
     engine = mindex.engine
     storage = index.storage
@@ -811,10 +896,15 @@ def compact(
             pq_dim=index.pq_dim,
             pq_bits=index.pq_bits,
         )
-    out = wrap_mutable(new_index, delta_cap=mindex.delta.cap)
+    out = wrap_mutable(new_index, delta_cap=mindex.delta.cap,
+                       name=mindex.name)
     out.dirty_lists = set(range(nl))   # every list changed on disk
     stats["max_list"] = st.max_list
     stats["n_slab"] = nb
+    ms = _mseries(mindex.name)
+    ms["op_ms"]["compact"].observe(
+        (time.perf_counter() - t_compact0) * 1e3)
+    ms["compactions"].inc()
     return out, stats
 
 

@@ -1220,6 +1220,133 @@ def test_dcn_wide_collective_suppression_honored():
     assert out == []
 
 
+# -- metrics-in-traced-body --------------------------------------------------
+
+def metric_findings(src):
+    return findings(src, "metrics-in-traced-body")
+
+
+def test_metrics_in_traced_body_flags_recorder_calls():
+    # the trace-time flatline: .inc()/.observe() under a tracer fires
+    # once at trace time and never per dispatch
+    out = metric_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, c, h):
+            c.inc()
+            h.observe(1.0)
+            return x + 1
+    """)
+    assert len(out) == 2
+    msgs = " ".join(f.message for f in out)
+    assert "trace time" in msgs and "c.inc()" in msgs
+
+
+def test_metrics_in_traced_body_clock_feeding_recorder():
+    # a perf_counter read feeding an observe — through a name and as a
+    # direct argument — is a trace-time constant, flagged alongside the
+    # recorder call itself
+    out = metric_findings("""
+        import jax
+        import time
+
+        @jax.jit
+        def body(x, h):
+            t0 = time.perf_counter()
+            y = x + 1
+            h.observe(time.perf_counter() - t0)
+            return y
+    """)
+    assert len(out) == 3        # the observe + both clock reads
+    msgs = " ".join(f.message for f in out)
+    assert "TRACE-TIME" in msgs and "perf_counter" in msgs
+
+
+def test_metrics_in_traced_body_array_at_set_unflagged():
+    # `.set` fires only on metric-shaped receivers: the tombstone
+    # mask's `arr.at[i].set(0)` (a Subscript receiver) and ordinary
+    # setters must never match
+    out = metric_findings("""
+        import jax
+
+        @jax.jit
+        def body(mask, i, cfg):
+            cfg.set(True)
+            return mask.at[i].set(0)
+    """)
+    assert out == []
+
+
+def test_metrics_in_traced_body_gauge_set_flagged():
+    out = metric_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, fill_gauge, reg):
+            fill_gauge.set(0.5)
+            reg.gauge("depth").set(1)
+            return x
+    """)
+    assert len(out) == 2
+
+
+def test_metrics_in_traced_body_g_handle_convention_flagged():
+    # the repo's own gauge-handle spelling (`self._g_*` / `_G_*`) must
+    # not evade the rule the same PR ships (review-caught r13)
+    out = metric_findings("""
+        import jax
+
+        @jax.jit
+        def body(self, x):
+            self._g_coverage.set(1.0)
+            return x
+    """)
+    assert len(out) == 1
+
+
+def test_metrics_in_traced_body_host_path_clean():
+    # the intended pattern — stamps AROUND the dispatch on the host —
+    # is exactly what the executor does; nothing traced, nothing
+    # flagged (bare clock reads in a traced body without a recorder
+    # are recompile-hazard territory, not this rule's)
+    out = metric_findings("""
+        import time
+
+        def serve(h, fn, x):
+            t0 = time.perf_counter()
+            out = fn(x)
+            h.observe((time.perf_counter() - t0) * 1e3)
+            return out
+    """)
+    assert out == []
+
+
+def test_metrics_in_traced_body_bare_clock_unflagged():
+    out = metric_findings("""
+        import jax
+        import time
+
+        @jax.jit
+        def body(x):
+            t = time.time()
+            return x
+    """)
+    assert out == []
+
+
+def test_metrics_in_traced_body_suppression_honored():
+    out = metric_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, c):
+            c.inc()  # jaxlint: disable=metrics-in-traced-body
+            return x
+    """)
+    assert out == []
+
+
 # -- engine: baseline, CLI, self-gate ---------------------------------------
 
 FIXTURE_BAD = textwrap.dedent("""

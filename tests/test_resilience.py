@@ -749,14 +749,22 @@ def test_failover_flip_zero_retrace(comms8, dataset, replicated_flat,
 
 
 def test_open_loop_executor_failover_chaos(comms8, dataset,
-                                           replicated_flat, monkeypatch):
+                                           replicated_flat, monkeypatch,
+                                           tmp_path):
     """ISSUE 8 chaos acceptance: ONE open-loop executor serves a
     request stream through a mid-stream rank failure with R=2 — the
     hedge covers the straggling batches, the FailoverPlan route flows
     in as a runtime input, every answer stays bit-identical to the
     healthy mesh at coverage 1.0, and the compiled program never
-    retraces."""
+    retraces.
+
+    ISSUE 13 extension: a FlightRecorder rides the same executor, and
+    its dump must tell the postmortem story — the straggling batch the
+    hedge covered, the backup winning the race, and the failover flip's
+    route — while the live retrace census reads the same program count
+    the trace audit pins."""
     from raft_tpu.comms import mnmg_ivf_flat as mod
+    from raft_tpu.obs import FlightRecorder, program_census
     from raft_tpu.serving import ServingExecutor
 
     _, q = dataset                                   # (12, 16) queries
@@ -797,10 +805,13 @@ def test_open_loop_executor_failover_chaos(comms8, dataset,
     straggler_s = 1.0
     primary, audit = faults.inject_straggler(run, every=3,
                                              seconds=straggler_s)
+    recorder = FlightRecorder(1024, dump_dir=str(tmp_path),
+                              name="chaos")
     ex = ServingExecutor(
         primary, buckets, dim=q.shape[1], flush_age_s=0.0,
         max_in_flight=2, hedge=0.02, backup_dispatch=run,
         runtime_inputs={"shard_mask": health.mask(), "failover": plan0},
+        flight=recorder,
     )
     lat_ms = []
     results = []
@@ -825,7 +836,7 @@ def test_open_loop_executor_failover_chaos(comms8, dataset,
     # rank 3 dies MID-STREAM: route its shard to the replica via the
     # executor's runtime inputs — later dispatches pick it up, nothing
     # recompiles
-    health.mark_down(3)
+    faults.fail_rank(health, 3)
     plan = FailoverPlan.from_health(placement, health)
     assert plan.fully_covered
     ex.set_runtime(shard_mask=health.mask(), failover=plan)
@@ -856,6 +867,36 @@ def test_open_loop_executor_failover_chaos(comms8, dataset,
         "the open-loop stream must reuse the cached program object"
     assert fn._cache_size() == size0, \
         "health/failover flips through the executor must not retrace"
+    # the LIVE retrace gauge reads the same program count the trace
+    # audit just pinned — the zero-retrace contract as a runtime metric
+    census = program_census({"mnmg_ivf_flat._cached_search": fn})
+    assert census["mnmg_ivf_flat._cached_search"] == size0
+
+    # -- the flight-recorder postmortem (ISSUE 13 acceptance) ---------
+    # the dump must NAME (a) the straggling batch the hedge covered,
+    # (b) the hedge winner, (c) the failover flip's route
+    hedges = recorder.events(event="hedge")
+    assert hedges, "the injected stragglers must appear as hedge events"
+    straggler_batch = hedges[0]["batch_id"]
+    assert hedges[0]["age_ms"] >= 0.02 * 1e3 * 0.5
+    wins = [e for e in recorder.events(event="demux")
+            if e["winner"] == "backup"]
+    assert wins, "a backup win must be attributed in the recorder"
+    flips = [e for e in recorder.events(event="runtime_update")
+             if "failover_route" in e]
+    # the mid-stream flip routes rank 3's shard to replica copy 1
+    # (and the heal routes it back to 0)
+    assert any(e["failover_route"][3] == 1 for e in flips)
+    assert any(e["failover_route"][3] == 0 for e in flips)
+    path = recorder.dump("chaos-postmortem")
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[0]["reason"] == "chaos-postmortem"
+    dumped = {ln.get("event") for ln in lines[1:]}
+    assert {"hedge", "demux", "runtime_update"} <= dumped
+    assert any(ln.get("event") == "dispatch"
+               and ln.get("batch_id") == straggler_batch
+               for ln in lines[1:]), \
+        "the dump must show the straggling batch's dispatch"
 
 
 def test_failover_requires_shard_mask(comms8, dataset, replicated_flat):

@@ -1108,3 +1108,71 @@ def test_round12_bench_line_parses_with_program_audit_stamp():
     assert err["program_audit_error"] == "exit 1: drift"
     # and the stamp helper exists with the subprocess contract
     assert callable(benchtop._program_audit_stamp)
+
+
+def test_round13_bench_line_parses_with_obs_overhead():
+    """ISSUE 13 satellite (the _fit_line parse/cap test extended,
+    following the r05-r12 pattern): the round-13 artifact shape — every
+    prior row PLUS the open-loop row's ``obs_overhead_pct`` stamp
+    (saturation QPS with the metric registry enabled vs
+    ``RAFT_TPU_OBS=off``, docs/observability.md; acceptance <= ~2%) —
+    must print as a line that json.loads-round-trips under the
+    1800-char driver cap. The stamp is whitelisted-but-trimmable: the
+    open-loop row's saturation/ratio acceptance keys outrank it when
+    the line is tight."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r13", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01}
+        for i in range(8)
+    ] + [
+        # the round-13 open-loop row shape under test
+        {"metric": "open_loop_ivf_flat_500000x96", "unit": "QPS",
+         "scenario": "open_loop", "engine": "ivf_flat", "nq": 1024,
+         "program_qps": 1.8e5, "saturation_qps": 1.5e5,
+         "qps_ratio_vs_program": 0.83, "obs_overhead_pct": 1.4,
+         "spread": 0.03, "repeats": 5,
+         "p50_ms_50": 3.1, "p99_ms_50": 8.5, "p50_ms_80": 4.2,
+         "p99_ms_80": 14.9, "p50_ms_95": 6.8, "p99_ms_95": 31.0,
+         "shed_rate_95": 0.02, "vs_prev": 1.0},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "program_audit_ms": 34193.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # the stamp prints when the line has room...
+    small = benchtop._fit_line({
+        "metric": "open_loop_ivf_flat_500000x96", "unit": "QPS",
+        "saturation_qps": 1.5e5, "obs_overhead_pct": 1.4,
+        "extras": [],
+    })
+    assert json.loads(small)["obs_overhead_pct"] == 1.4
+    # ...is whitelisted-but-trimmable; the open-loop acceptance keys
+    # it annotates are not trimmable
+    assert "obs_overhead_pct" in benchtop._PRINT_KEYS
+    assert "obs_overhead_pct" in benchtop._TRIM_ORDER
+    for key in ("saturation_qps", "qps_ratio_vs_program"):
+        assert key in benchtop._PRINT_KEYS
+        assert key not in benchtop._TRIM_ORDER
