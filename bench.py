@@ -1342,6 +1342,13 @@ _PRINT_KEYS = {
     # subprocess alongside the bench — 0 findings is implied by the
     # stamp's presence (a red audit stamps program_audit_error instead)
     "program_audit_ms", "program_audit_error",
+    # the hot-traffic shaping row (ISSUE 15, docs/serving.md "Hot
+    # traffic"): cache+coalescing saturation vs the uncached path under
+    # a Zipf repeated-query mix (qps_uplift is the >= 1.5x acceptance;
+    # cached_identical pins equal recall on the exact tier)
+    "zipf_s", "n_templates", "uncached_qps", "cached_qps",
+    "qps_uplift", "cache_hit_rate", "coalesce_rate",
+    "p99_ms_cached", "p99_ms_uncached", "cached_identical",
 }
 
 
@@ -1361,6 +1368,10 @@ _TRIM_ORDER = (
     "repeats", "within_2x_warm", "escalations", "probe_flop_ratio",
     "probe_kernel", "build_warm_s", "program_audit_ms",
     "obs_overhead_pct",
+    # zipf_hot_traffic secondaries fall before its primary
+    # uplift/hit-rate evidence does
+    "n_templates", "zipf_s", "cached_identical", "coalesce_rate",
+    "p99_ms_uncached", "uncached_qps",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",

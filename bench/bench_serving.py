@@ -361,12 +361,19 @@ def mixed_ingest_row(idx, qb, *, k: int = 10, n_probes: int = 16,
     return row
 
 
-def _drive_open_loop(executor, schedule, qall, *, seed: int = 0):
+def _drive_open_loop(executor, schedule, qall, *, seed: int = 0,
+                     rows_fn=None):
     """Replay one open-loop schedule through the executor; returns
     ``(latencies_ms, n_shed, achieved_qps, max_lag_s)``. Latency is
     submit→future-resolution wall time per COMPLETED request; achieved
     QPS counts completed query rows over the span from first submit to
-    last completion (the open-loop throughput, sheds excluded)."""
+    last completion (the open-loop throughput, sheds excluded).
+
+    ``rows_fn(i, size)`` overrides the default random-unique row draw
+    with the request's EXACT rows — the ``zipf_hot_traffic`` row maps
+    each request's template id to a fixed block so hot templates
+    re-arrive bitwise identical (no uniqueness perturbation: the
+    result cache keys on the bytes)."""
     from raft_tpu import errors
     from raft_tpu.testing import load
 
@@ -376,8 +383,12 @@ def _drive_open_loop(executor, schedule, qall, *, seed: int = 0):
     q_pool = np.asarray(qall, np.float32)
 
     def submit(i, size):
-        rows = q_pool[rng.integers(0, q_pool.shape[0], size=size)]
-        fut = executor.submit(rows * (1.0 + 1e-6 * (i + 1)))
+        if rows_fn is not None:
+            rows = rows_fn(i, size)
+        else:
+            rows = q_pool[rng.integers(0, q_pool.shape[0], size=size)]
+            rows = rows * (1.0 + 1e-6 * (i + 1))
+        fut = executor.submit(rows)
 
         def _stamp(_f, i=i):
             with lock:
@@ -549,13 +560,175 @@ def open_loop_row(make_run, qall, *, buckets=(128, 1024),
     return row
 
 
+def zipf_hot_traffic_row(make_run, qall, *, k: int,
+                         buckets=(128, 1024), request_size: int = 16,
+                         n_templates: int = 64, zipf_s: float = 1.1,
+                         n_requests: int = 256,
+                         flush_age_s: float = 0.002,
+                         max_in_flight: int = 4, chain=(4, 32),
+                         escalate: int = 2, seed: int = 23,
+                         min_duration_s: float = 0.5,
+                         max_requests: int = 20_000,
+                         offered_x_cached: float = 4.0) -> dict:
+    """The hot-traffic shaping row (ISSUE 15, docs/serving.md "Hot
+    traffic"): saturation QPS and p99 under a Zipf(``zipf_s``)
+    repeated-query mix, measured TWICE at fixed hardware — the plain
+    executor (``uncached_qps``/``p99_ms_uncached``) vs the same
+    executor with the result cache + request coalescing enabled
+    (``cached_qps``/``p99_ms_cached``), plus ``qps_uplift`` (the >= 1.5x
+    acceptance), ``cache_hit_rate`` and ``coalesce_rate`` from the
+    executor's own counters, and ``cached_identical`` (a cached answer
+    re-served for a hot template is bitwise the uncached program's —
+    the exact tier serves at EQUAL recall by construction; the
+    semantic tier stays off here, its guardrail is a per-deployment
+    calibration).
+
+    Traffic: ``n_templates`` fixed query blocks of ``request_size``
+    rows; each request draws its template from
+    :func:`raft_tpu.testing.load.zipf_template_weights` — hot
+    templates re-arrive bitwise identical, exactly the traffic shape
+    the cache keys on. The cached arm is offered
+    ``offered_x_cached``x the raw program rate (the cache can clear
+    MORE than program QPS, so saturating it needs more offered load
+    than the uncached arm's 1.5x)."""
+    from bench.common import chained_dispatch_stats
+    from raft_tpu.resilience import AdmissionController
+    from raft_tpu.serving import BucketSet, ResultCache, ServingExecutor
+    from raft_tpu.testing.load import poisson_arrivals
+
+    bset = BucketSet.of(buckets)
+    runs = {b: make_run(b) for b in bset.sizes}
+    d = int(np.asarray(qall).shape[1])
+
+    def dispatch(batch, **_rt):
+        return runs[int(batch.shape[0])](batch)
+
+    for b in bset.sizes:
+        jax.block_until_ready(runs[b](jnp.zeros((b, d), jnp.float32)))
+
+    # the fixed template pool: template t IS a (request_size, d) block,
+    # re-submitted verbatim on every arrival of t
+    rng = np.random.default_rng(seed)
+    q_pool = np.asarray(qall, np.float32)
+    pool = np.stack([
+        q_pool[rng.integers(0, q_pool.shape[0], size=request_size)]
+        * (1.0 + 1e-6 * (t + 1))
+        for t in range(n_templates)
+    ])
+
+    big = bset.largest
+    qb = jnp.asarray(q_pool[:big])
+    st = chained_dispatch_stats(
+        lambda s: qb * (1.0 + 1e-6 * s), runs[big],
+        n1=chain[0], n2=chain[1], escalate=escalate,
+    )
+    row = {
+        "engine": "ivf_flat", "scenario": "zipf_hot_traffic",
+        "nq": big, "request_size": int(request_size),
+        "zipf_s": float(zipf_s), "n_templates": int(n_templates),
+    }
+    if st is None:
+        row["error"] = "jitter-dominated"
+        return row
+    program_qps = big / (st["ms"] / 1e3)
+    row["program_qps"] = round(program_qps, 1)
+    row["spread"] = st["spread"]
+    row["repeats"] = st["repeats"]
+
+    def fresh_executor(cache: bool):
+        rcache = None
+        if cache:
+            rcache = ResultCache(
+                k, n_sets=max(64, 2 * n_templates), associativity=8,
+                name="zipf_bench",
+            )
+        return ServingExecutor(
+            dispatch, bset, dim=d, flush_age_s=flush_age_s,
+            max_in_flight=max_in_flight,
+            admission=AdmissionController(
+                max_concurrent=max(1, 4 * big // request_size),
+                max_queue=max(8, 4 * big // request_size),
+            ),
+            result_cache=rcache,
+        )
+
+    def n_for(rate_rps):
+        return int(min(max_requests,
+                       max(n_requests, min_duration_s * rate_rps)))
+
+    def drive(ex, rate_rps, seed_pt):
+        sched = poisson_arrivals(
+            rate_rps, n_for(rate_rps), seed=seed_pt,
+            sizes=request_size, zipf_s=zipf_s, n_templates=n_templates,
+        )
+        return _drive_open_loop(
+            ex, sched, q_pool, seed=seed_pt,
+            rows_fn=lambda i, _size, s=sched: pool[
+                int(s.template_ids[i])],
+        )
+
+    results = {}
+    for arm, offered_x in (("uncached", 1.5),
+                           ("cached", offered_x_cached)):
+        rate = offered_x * program_qps / request_size
+        with fresh_executor(arm == "cached") as ex:
+            _, _, sat_qps, _ = drive(ex, rate, seed)
+            sat_stats = ex.stats()
+        # p99 at 80% of the arm's OWN measured saturation
+        p99_rate = 0.8 * sat_qps / request_size
+        if p99_rate > 0:
+            with fresh_executor(arm == "cached") as ex:
+                lat_ms, _, _, _ = drive(ex, p99_rate, seed + 7)
+            if lat_ms:
+                row[f"p99_ms_{arm}"] = round(
+                    float(np.percentile(np.asarray(lat_ms), 99)), 3)
+        results[arm] = (sat_qps, sat_stats)
+
+    row["uncached_qps"] = round(results["uncached"][0], 1)
+    row["cached_qps"] = round(results["cached"][0], 1)
+    if results["uncached"][0] > 0:
+        row["qps_uplift"] = round(
+            results["cached"][0] / results["uncached"][0], 3)
+    st_c = results["cached"][1]
+    if st_c.submitted:
+        row["cache_hit_rate"] = round(
+            st_c.cache_hits / st_c.submitted, 3)
+        row["coalesce_rate"] = round(
+            st_c.coalesced_requests / st_c.submitted, 3)
+
+    # equal-recall spot check: the cached answer for a hot template is
+    # bitwise the warmed program's own answer for that template block
+    b0 = bset.select(request_size)
+    padded = np.zeros((b0, d), np.float32)
+    padded[:request_size] = pool[0]
+    ref_ids = np.asarray(runs[b0](jnp.asarray(padded))[1])[:request_size]
+    rc_spot = ResultCache(k, n_sets=max(64, 2 * n_templates),
+                          associativity=8, name="zipf_spot")
+    with ServingExecutor(dispatch, bset, dim=d,
+                         flush_age_s=flush_age_s,
+                         result_cache=rc_spot) as ex:
+        ex.submit(pool[0]).result(timeout=60)
+        # the cache fill is asynchronous (the demux thread writes it
+        # AFTER resolving the caller) — wait for the insert so the
+        # re-submit exercises the hit path, not a fill race
+        t0 = time.monotonic()
+        while rc_spot.stats().inserts < request_size \
+                and time.monotonic() - t0 < 10.0:
+            time.sleep(0.002)
+        cached = ex.submit(pool[0]).result(timeout=60)
+        hit = ex.stats().cache_hits >= 1
+    row["cached_identical"] = bool(
+        hit and np.array_equal(np.asarray(cached[1]), ref_ids))
+    return row
+
+
 def serving_latency_rows(
     n: int = 500_000, d: int = 96, k: int = 10, n_probes: int = 16,
     n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
                                            "ivf_pq"),
     chain=(4, 32), escalate: int = 2,
     hedged: bool = True, overload: bool = True, mixed: bool = True,
-    open_loop: bool = True,
+    open_loop: bool = True, zipf: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
@@ -720,6 +893,37 @@ def serving_latency_rows(
         except Exception as e:                       # noqa: BLE001
             rows.append({
                 "engine": "ivf_flat", "scenario": "open_loop",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+
+    # the hot-traffic shaping row (ISSUE 15): Zipf repeated-query mix,
+    # cache+coalescing saturation vs the uncached path at fixed hardware
+    if zipf and "ivf_flat" in engines:
+        try:
+            idx = get_index("ivf_flat")
+            z_buckets = tuple(sorted({nq for nq in nqs if nq > 1})
+                              or {max(nqs)})
+
+            def make_run_z(bucket, idx=idx):
+                qcap = idx.warmup(bucket, k=k, n_probes=n_probes)
+
+                def run(qq, qcap=qcap):
+                    return ivf_flat_search_grouped(
+                        idx, qq, k, n_probes=n_probes, qcap=qcap,
+                    )
+                return run
+
+            rows.append(zipf_hot_traffic_row(
+                make_run_z, np.asarray(qall), k=k,
+                buckets=z_buckets,
+                request_size=max(1, min(16, max(z_buckets) // 8)),
+                n_templates=min(64, max(8, 4 * len(z_buckets) * 8)),
+                n_requests=min(256, 32 * len(z_buckets) * 4),
+                chain=chain, escalate=escalate,
+            ))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "ivf_flat", "scenario": "zipf_hot_traffic",
                 "error": f"{type(e).__name__}: {e}"[:160],
             })
 

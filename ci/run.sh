@@ -71,6 +71,13 @@ run_tests() {
     # metrics-in-traced-body rule it motivates).
     echo "== observability smoke (tests/test_obs.py) =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
+    # Hot-traffic shaping smoke (ISSUE 15): the result cache sits in
+    # front of every serving dispatch, so a correctness bug there (a
+    # stale entry served, a coalesced future misrouted) poisons every
+    # later serving measurement — fail fast before the long mesh run
+    # (which repeats it).
+    echo "== result-cache smoke (tests/test_result_cache.py) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_result_cache.py -q
     echo "== tests (virtual 8-device CPU mesh) =="
     # Wall time ~9 min on a 1-core host: dominated by jit compile/trace
     # of the shard_map phase programs and bf16-emulated quantizer

@@ -29,6 +29,7 @@ def _collect() -> List[Rule]:
         mutation_retrace,
         prng_discipline,
         recompile_hazard,
+        stale_epoch_read,
         sync_in_hot_path,
         tracer_safety,
         x64_hygiene,
@@ -38,7 +39,8 @@ def _collect() -> List[Rule]:
     for mod in (api_compat, tracer_safety, recompile_hazard,
                 x64_hygiene, prng_discipline, adc_gather,
                 mutation_retrace, sync_in_hot_path,
-                dcn_wide_collective, metrics_in_traced_body):
+                dcn_wide_collective, metrics_in_traced_body,
+                stale_epoch_read):
         out.extend(mod.RULES)
     return out
 

@@ -2,12 +2,15 @@
 cpp/include/raft/cache/cache_util.cuh:45-334 (``get_vecs``, ``store_vecs``,
 ``assign_cache_idx``, ``rank_set_entries``): an LRU-ish cache of feature
 vectors keyed by integer id, used to avoid recomputing expensive per-vector
-work (the reference's use case is SVM kernel columns).
+work (the reference's use case is SVM kernel columns; the serving tier's is
+the hot-traffic result cache, raft_tpu/serving/result_cache.py).
 
 Functional JAX state: (keys, time, store) arrays updated out-of-place; the
 class wraps them with an imperative facade like the reference's
 ``cache::Cache``. Lookup and placement are dense gathers/scatters over the
-associativity dimension — no host branching.
+associativity dimension — no host branching — and each operation runs as
+ONE jitted program (the result cache calls these per serving submit, where
+an eager ~10-op dispatch chain per lookup was the whole cache cost).
 """
 
 from __future__ import annotations
@@ -18,6 +21,85 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["VectorCache"]
+
+
+@jax.jit
+def _get_impl(keys, time, store, q, clock):
+    """(vecs, found, new_time): gather hits + LRU touch, one program."""
+    n_sets = keys.shape[0]
+    sets = q % n_sets
+    lane_keys = keys[sets]                           # (q, assoc)
+    hit = lane_keys == q[:, None]
+    found = jnp.any(hit, axis=1)
+    lane = jnp.argmax(hit, axis=1)
+    vecs = store[sets, lane]
+    vecs = jnp.where(found[:, None], vecs, 0)
+    new_time = time.at[sets, lane].set(
+        jnp.where(found, clock, time[sets, lane])
+    )
+    return vecs, found, new_time
+
+
+@jax.jit
+def _store_impl(keys, time, store, k, v, clock):
+    """(new_keys, new_time, new_store): ranked placement, one program."""
+    n_sets, assoc = keys.shape
+    B = k.shape[0]
+    sets = k % n_sets
+    lane_keys = keys[sets]
+    hit = lane_keys == k[:, None]
+    found = jnp.any(hit, axis=1)
+    hit_lane = jnp.argmax(hit, axis=1)
+    # lanes being UPDATED by this batch are not victims: a key-update
+    # and a new-key insert in the same set must never scatter to one
+    # slot (duplicate-index scatters apply per array in unspecified
+    # order — keys/time/store could disagree and a later get would
+    # serve the wrong vector)
+    safe_lane = jnp.where(found, hit_lane, assoc)       # OOB drops
+    hit_mask = jnp.zeros(keys.shape, jnp.bool_).at[
+        sets, safe_lane
+    ].set(True, mode="drop")
+    time_rank = jnp.where(hit_mask, jnp.iinfo(jnp.int32).max, time)
+    # within-batch rank among NEW keys targeting the same set (the
+    # two-pass stable-sort idiom; update rows sort into a sentinel
+    # group so they consume no victim rank) -> the rank-th LRU lane,
+    # so two colliding inserts can never overwrite each other's slot
+    sets_rank = jnp.where(found, n_sets, sets)
+    order = jnp.argsort(sets_rank, stable=True)
+    ss = sets_rank[order]
+    starts = jnp.searchsorted(
+        ss, jnp.arange(n_sets, dtype=ss.dtype)
+    ).astype(jnp.int32)
+    within = jnp.zeros((B,), jnp.int32).at[order].set(
+        jnp.arange(B, dtype=jnp.int32)
+        - starts[jnp.clip(ss, 0, n_sets - 1)]
+    )
+    lru = jnp.argsort(time_rank[sets], axis=1, stable=True)
+    victim = jnp.take_along_axis(
+        lru, (within % assoc)[:, None], axis=1
+    )[:, 0]
+    lane = jnp.where(found, hit_lane, victim)
+    # duplicate keys collapse: later occurrences write the FIRST
+    # occurrence's lane (last write wins there)
+    first_idx = jnp.argmax(k[None, :] == k[:, None], axis=1)
+    lane = lane[first_idx]              # (duplicates share a set too)
+    return (
+        keys.at[sets, lane].set(k),
+        time.at[sets, lane].set(clock),
+        store.at[sets, lane].set(v),
+    )
+
+
+@jax.jit
+def _evict_impl(keys, k):
+    n_sets = keys.shape[0]
+    sets = k % n_sets
+    hit = keys[sets] == k[:, None]
+    lane = jnp.argmax(hit, axis=1)
+    found = jnp.any(hit, axis=1)
+    return keys.at[sets, lane].set(
+        jnp.where(found, -1, keys[sets, lane])
+    )
 
 
 class VectorCache:
@@ -45,48 +127,30 @@ class VectorCache:
         """Fetch vectors for ``query_keys``; returns (vecs (q, dim), found
         (q,) bool) (reference get_vecs: gathers hits, reports misses)."""
         q = jnp.asarray(query_keys, jnp.int32)
-        sets = q % self.n_sets
-        lane_keys = self.keys[sets]                      # (q, assoc)
-        hit = lane_keys == q[:, None]
-        found = jnp.any(hit, axis=1)
-        lane = jnp.argmax(hit, axis=1)
-        vecs = self.store[sets, lane]
-        vecs = jnp.where(found[:, None], vecs, 0)
-        # touch hit entries (LRU time update)
         self.clock += 1
-        self.time = self.time.at[sets, lane].set(
-            jnp.where(found, self.clock, self.time[sets, lane])
+        vecs, found, self.time = _get_impl(
+            self.keys, self.time, self.store, q, jnp.int32(self.clock)
         )
         return vecs, found
 
     def store_vecs(self, store_keys, vecs) -> None:
-        """Insert vectors, evicting the LRU entry of each target set
-        (reference store_vecs + assign_cache_idx). Duplicate keys within
-        one call collapse to a single slot (last write wins per scatter
-        semantics)."""
+        """Insert vectors, evicting least-recently-used entries of each
+        target set (reference store_vecs + assign_cache_idx). DISTINCT
+        keys mapping to the same set within one call claim DISTINCT
+        victim lanes (their within-batch rank indexes the set's LRU
+        order — the reference's rank_set_entries/assign_cache_idx
+        contract; beyond the associativity they wrap and overwrite).
+        Duplicate keys within one call collapse to a single slot (last
+        write wins per scatter semantics)."""
         k = jnp.asarray(store_keys, jnp.int32)
-        v = jnp.asarray(vecs)
-        sets = k % self.n_sets
-        lane_keys = self.keys[sets]
-        hit = lane_keys == k[:, None]
-        found = jnp.any(hit, axis=1)
-        hit_lane = jnp.argmax(hit, axis=1)
-        # victim: least-recently-used lane of the set (empty lanes have
-        # time 0 and lose ties -> filled first)
-        victim = jnp.argmin(self.time[sets], axis=1)
-        lane = jnp.where(found, hit_lane, victim)
+        v = jnp.asarray(vecs, self.store.dtype)
         self.clock += 1
-        self.keys = self.keys.at[sets, lane].set(k)
-        self.time = self.time.at[sets, lane].set(self.clock)
-        self.store = self.store.at[sets, lane].set(v)
+        self.keys, self.time, self.store = _store_impl(
+            self.keys, self.time, self.store, k, v,
+            jnp.int32(self.clock),
+        )
 
     def evict(self, keys) -> None:
         """Invalidate entries (no direct reference analog; utility)."""
         k = jnp.asarray(keys, jnp.int32)
-        sets = k % self.n_sets
-        hit = self.keys[sets] == k[:, None]
-        lane = jnp.argmax(hit, axis=1)
-        found = jnp.any(hit, axis=1)
-        self.keys = self.keys.at[sets, lane].set(
-            jnp.where(found, -1, self.keys[sets, lane])
-        )
+        self.keys = _evict_impl(self.keys, k)

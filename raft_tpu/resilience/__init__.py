@@ -58,6 +58,9 @@ from raft_tpu.resilience.health import (
 from raft_tpu.resilience.replica import (
     FailoverPlan,
     ReplicaPlacement,
+    measured_shard_load,
+    popularity_replication,
+    record_shard_load,
     resolve_route,
 )
 
@@ -78,4 +81,7 @@ __all__ = [
     "FailoverPlan",
     "ReplicaPlacement",
     "resolve_route",
+    "record_shard_load",
+    "measured_shard_load",
+    "popularity_replication",
 ]

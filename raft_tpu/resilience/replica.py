@@ -41,7 +41,14 @@ import numpy as np
 from raft_tpu import errors
 from raft_tpu.obs import metrics as obs_metrics
 
-__all__ = ["ReplicaPlacement", "FailoverPlan", "resolve_route"]
+__all__ = [
+    "ReplicaPlacement",
+    "FailoverPlan",
+    "resolve_route",
+    "record_shard_load",
+    "measured_shard_load",
+    "popularity_replication",
+]
 
 # failover-routing telemetry (ISSUE 13, docs/observability.md): every
 # plan built counts, and the two gauges show the CURRENT routing
@@ -210,6 +217,121 @@ class ReplicaPlacement:
         return self.replication
 
 
+# -- popularity-aware replication (ISSUE 15, docs/serving.md "Hot
+# traffic"): Zipf-skewed traffic concentrates load on a few HOT shards,
+# so a uniform per-shard replication factor either under-covers the hot
+# shards or wastes memory on the cold ones. The measured per-shard
+# dispatch load (counters the serving tier records per probe-routed
+# row) drives two host-side decisions — a NON-UNIFORM replication
+# vector (how many copies each shard deserves within a fixed copy
+# budget) and a LOAD-WEIGHTED failover route
+# (:meth:`FailoverPlan.load_balanced`). Both are planning VALUES only:
+# the route stays a runtime operand of the same compiled programs, so
+# a popularity-driven re-route can never retrace (trace-audited in
+# tests/test_result_cache.py).
+
+_SHARD_LOAD_METRIC = "serving_shard_rows_total"
+
+
+def record_shard_load(shard_rows, *, registry=None,
+                      name: str = _SHARD_LOAD_METRIC) -> None:
+    """Accumulate a per-shard dispatched-row count vector into the
+    ``{name}{shard=s}`` counters — the measurement side of
+    popularity-aware replication. Callers hand in whatever granularity
+    they have (per-batch probe→owner histograms, a bench's offered
+    template mix, :meth:`FailoverPlan.serving_load`); the counters sum
+    it process-wide. ``RAFT_TPU_OBS=off`` no-ops it like every
+    recorder."""
+    rows = np.asarray(shard_rows)
+    errors.expects(rows.ndim == 1,
+                   "record_shard_load: expected a (P,) vector, got %s",
+                   tuple(rows.shape))
+    reg = obs_metrics.default_registry() if registry is None else registry
+    for s in range(rows.shape[0]):
+        n = int(rows[s])
+        if n:
+            reg.counter(name, shard=s).inc(n)
+
+
+def measured_shard_load(n_shards: int, *, registry=None,
+                        name: str = _SHARD_LOAD_METRIC) -> np.ndarray:
+    """The accumulated per-shard load, ``(P,)`` float64 (zeros where no
+    traffic was recorded) — the input of
+    :func:`popularity_replication` and
+    :meth:`FailoverPlan.load_balanced`."""
+    errors.expects(n_shards >= 1,
+                   "measured_shard_load: n_shards=%d < 1", n_shards)
+    reg = obs_metrics.default_registry() if registry is None else registry
+    load = np.zeros(n_shards, np.float64)
+    for inst in reg.series(name):
+        s = inst.labels.get("shard")
+        if s is None:
+            continue
+        s = int(s)
+        if 0 <= s < n_shards:
+            load[s] += float(inst.value)
+    return load
+
+
+def popularity_replication(load, *, budget: int, r_min: int = 1,
+                           r_max: "int | None" = None) -> np.ndarray:
+    """Distribute a fixed copy ``budget`` over shards proportionally to
+    measured load (largest-remainder apportionment): every shard keeps
+    at least ``r_min`` copies (availability floor — a cold shard must
+    still survive a failure), hot shards absorb the surplus up to
+    ``r_max`` (default: the shard count, i.e. uncapped). Returns the
+    ``(P,)`` int replication vector, summing exactly to ``budget``.
+
+    This is a PLANNING output: the slab layout stays the uniform-R
+    :class:`ReplicaPlacement` (the compiled programs depend on its
+    statics), and the vector says where the NEXT capacity decision —
+    which R to rebuild with, which shards to pin an extra standby for,
+    which copies a load-weighted route should prefer — pays off.
+    With uniform load it degenerates to uniform replication."""
+    load = np.asarray(load, np.float64)
+    p = load.shape[0]
+    errors.expects(load.ndim == 1 and p >= 1,
+                   "popularity_replication: expected a (P,) load "
+                   "vector, got %s", tuple(load.shape))
+    r_max = p if r_max is None else int(r_max)
+    errors.expects(
+        1 <= r_min <= r_max,
+        "popularity_replication: need 1 <= r_min=%d <= r_max=%d",
+        r_min, r_max,
+    )
+    errors.expects(
+        p * r_min <= budget <= p * r_max,
+        "popularity_replication: budget=%d cannot satisfy %d shards "
+        "with copies in [%d, %d]", budget, p, r_min, r_max,
+    )
+    copies = np.full(p, r_min, np.int64)
+    spare = budget - p * r_min
+    total = float(load.sum())
+    share = (load / total if total > 0
+             else np.full(p, 1.0 / p)) * spare
+    grant = np.minimum(np.floor(share).astype(np.int64),
+                       r_max - r_min)
+    copies += grant
+    left = budget - int(copies.sum())
+    # largest remainders first (ties: lower shard id — deterministic)
+    rem = np.where(copies < r_max, share - np.floor(share), -1.0)
+    for s in np.lexsort((np.arange(p), -rem)):
+        if left == 0:
+            break
+        if copies[s] < r_max:
+            copies[s] += 1
+            left -= 1
+    # r_max clamping can strand budget; spread it over the coldest
+    # shards that still have headroom
+    while left > 0:
+        open_s = np.nonzero(copies < r_max)[0]
+        take = open_s[np.argsort(load[open_s], kind="stable")]
+        for s in take[:left]:
+            copies[s] += 1
+        left = budget - int(copies.sum())
+    return copies.astype(np.int32)
+
+
 def _alive_mask(health: Any, n_ranks: int) -> np.ndarray:
     # local import: degraded.py is jax-importing; keep this module
     # usable from a mesh-free control plane unless a mask must resolve
@@ -289,6 +411,52 @@ class FailoverPlan:
         )
         alive = np.repeat((host_alive != 0).astype(np.int32), inner)
         return cls.from_health(placement, alive)
+
+    @classmethod
+    def load_balanced(cls, placement: ReplicaPlacement, health: Any,
+                      load=None, *, registry=None) -> "FailoverPlan":
+        """The LOAD-WEIGHTED route (ISSUE 15): among each shard's live
+        holders, pick the copy that keeps the per-rank served load most
+        even — hot shards claim their least-loaded live holder FIRST
+        (descending measured load, so the ranks that must also absorb
+        their hedged re-dispatches stay coolest), cold shards fill in
+        around them. ``load`` is the ``(P,)`` measured per-shard load
+        (default: :func:`measured_shard_load` from the registry's
+        dispatch counters). Ties prefer the lower copy index, so a
+        healthy mesh under uniform load yields exactly
+        :meth:`from_health`'s all-zeros route.
+
+        Route VALUES only: the result is an ordinary
+        :class:`FailoverPlan` over the same placement, consumed by the
+        same ``(P,)`` runtime route input — a popularity-driven
+        re-route never retraces the serving program."""
+        alive = _alive_mask(health, placement.n_ranks)
+        p = placement.n_ranks
+        if load is None:
+            load = measured_shard_load(p, registry=registry)
+        load = np.asarray(load, np.float64)
+        errors.expects(
+            load.shape == (p,),
+            "load_balanced: expected a (%d,) load vector, got %s",
+            p, tuple(load.shape),
+        )
+        route = np.full(p, -1, np.int32)
+        rank_load = np.zeros(p, np.float64)
+        # hottest shards pick first (stable ties by shard id)
+        for s in np.lexsort((np.arange(p), -load)):
+            best_j, best_r = -1, -1
+            for j, r in enumerate(placement.holders(int(s))):
+                if not alive[r]:
+                    continue
+                if best_j < 0 or rank_load[r] < rank_load[best_r]:
+                    best_j, best_r = j, r
+            if best_j >= 0:
+                route[s] = best_j
+                rank_load[best_r] += load[s]
+        _M_PLANS.inc()
+        _G_REROUTED.set(int((route > 0).sum()))
+        _G_UNSERVED.set(int((route < 0).sum()))
+        return cls(placement=placement, route=route)
 
     @property
     def fully_covered(self) -> bool:

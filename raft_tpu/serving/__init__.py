@@ -19,10 +19,17 @@ throughput (ROADMAP item 3, "millions of users"):
   :class:`~raft_tpu.resilience.HedgePolicy`-driven straggler hedging
   onto a backup replica, and ``shard_mask``/``FailoverPlan`` route
   arrays flowing through as runtime inputs;
+* :class:`~raft_tpu.serving.result_cache.ResultCache` /
+  :class:`~raft_tpu.serving.result_cache.CentroidSigner` — the
+  hot-traffic shaping layer (docs/serving.md "Hot traffic"): an
+  exact + semantic query-result cache over the set-associative
+  :class:`~raft_tpu.cache.VectorCache`, invalidated by mutation
+  epoch, feeding the executor's submit-side cache hits and request
+  coalescing;
 * the deterministic Poisson load generator feeding it lives in
-  :mod:`raft_tpu.testing.load` (seeded open-loop arrival schedules —
-  the bench's offered-load sweep and the chaos suite replay the same
-  traffic).
+  :mod:`raft_tpu.testing.load` (seeded open-loop arrival schedules
+  — plus the Zipf repeated-query mix — the bench's offered-load
+  sweep and the chaos suite replay the same traffic).
 """
 
 from raft_tpu.serving.batching import (
@@ -32,6 +39,12 @@ from raft_tpu.serving.batching import (
     pack_requests,
 )
 from raft_tpu.serving.executor import ExecutorStats, ServingExecutor
+from raft_tpu.serving.result_cache import (
+    CentroidSigner,
+    ResultCache,
+    ResultCacheStats,
+    semantic_recall,
+)
 
 __all__ = [
     "BucketSet",
@@ -40,4 +53,8 @@ __all__ = [
     "pack_requests",
     "ExecutorStats",
     "ServingExecutor",
+    "CentroidSigner",
+    "ResultCache",
+    "ResultCacheStats",
+    "semantic_recall",
 ]

@@ -93,6 +93,17 @@ class PendingRequest:
     # executor stamps a per-process sequence at submit so one request's
     # span events (submit→pack→dispatch→hedge→demux) join up
     req_id: int = -1
+    # hot-traffic shaping (ISSUE 15): the request's per-row exact
+    # signatures (computed once at submit — the coalescing key AND the
+    # cache-fill key), the coalescing-leader map key this request
+    # registered under (None = not a leader), and the futures of
+    # requests COALESCED onto this one. Followers are resolved from
+    # the demuxed batch result directly — NOT by mirroring this
+    # request's own future, so a caller cancelling the leader can
+    # never cancel an unrelated follower.
+    sigs: Optional[np.ndarray] = None
+    sig_key: Optional[tuple] = None
+    followers: List[object] = dataclasses.field(default_factory=list)
 
     @property
     def n_rows(self) -> int:

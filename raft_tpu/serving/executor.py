@@ -68,6 +68,7 @@ from raft_tpu.serving.batching import (
     PendingRequest,
     pack_requests,
 )
+from raft_tpu.serving.result_cache import ResultCache, exact_signatures
 
 __all__ = ["ServingExecutor", "ExecutorStats", "STAGES"]
 
@@ -99,6 +100,8 @@ class ExecutorStats:
     backup_wins: int          # hedged batches the backup answered first
     pending: int              # gauge: requests waiting to be batched
     in_flight: int            # gauge: batches dispatched, not demuxed
+    # NOTE: new fields are APPENDED with defaults (after the r13 stage
+    # dicts below) so pre-r15 positional constructions stay valid
     # histogram-derived per-stage latency quantiles (ISSUE 13): stage
     # name -> milliseconds, pooled across this executor's buckets via
     # the registry's log2 histograms. Appended with defaults so every
@@ -108,6 +111,15 @@ class ExecutorStats:
         default_factory=dict)
     stage_p99_ms: Dict[str, float] = dataclasses.field(
         default_factory=dict)
+    # hot-traffic shaping (ISSUE 15, docs/serving.md "Hot traffic"),
+    # appended with byte-compatible defaults like the r13 stage dicts:
+    # requests answered by subscribing to an identical in-flight
+    # request's future (they never consumed micro-batch rows), requests
+    # served straight from the result cache, and cached ROW entries
+    # that died on an epoch mismatch (the invalidation counter)
+    coalesced_requests: int = 0
+    cache_hits: int = 0
+    cache_stale: int = 0
 
     @property
     def pad_fraction(self) -> float:
@@ -122,10 +134,11 @@ class _InFlight:
     """One dispatched micro-batch awaiting demux."""
 
     __slots__ = ("batch", "candidates", "t_dispatch", "ticket",
-                 "runtime", "hedged", "t_hedge_attempt")
+                 "runtime", "hedged", "t_hedge_attempt", "epoch")
 
     def __init__(self, batch: MicroBatch, out: Any, t_dispatch: float,
-                 ticket: Optional[int], runtime: Dict[str, Any]):
+                 ticket: Optional[int], runtime: Dict[str, Any],
+                 epoch: int = 0):
         self.batch = batch
         self.candidates: List[Any] = [out]   # [primary, backup?]
         self.t_dispatch = t_dispatch
@@ -133,6 +146,11 @@ class _InFlight:
         self.runtime = runtime
         self.hedged = False
         self.t_hedge_attempt: Optional[float] = None
+        # the mutation epoch the dispatch ran under — cache fills are
+        # stamped with THIS value, captured with the runtime snapshot
+        # (stamping the completion-time epoch would mark pre-write
+        # results fresh after a mid-flight write)
+        self.epoch = epoch
 
 
 def _ready(tree: Any) -> bool:
@@ -193,6 +211,18 @@ class ServingExecutor:
     demux) is traced by id and the ring is auto-dumped as JSONL when a
     batch fails or ``close()`` finds failures outstanding
     (docs/observability.md "Flight recorder").
+
+    ``result_cache`` / ``epoch_fn`` / ``coalesce`` — hot-traffic
+    shaping (ISSUE 15, docs/serving.md "Hot traffic"): a
+    :class:`~raft_tpu.serving.ResultCache` serves repeated queries
+    before they reach admission or a micro-batch (fills are stamped
+    with the dispatch-time mutation epoch from ``epoch_fn``, default
+    constant 0 for frozen indexes; ``set_runtime`` re-samples it with
+    every state swap), and coalescing (on by default whenever a cache
+    is given) subscribes an identical same-epoch in-flight duplicate
+    to the original's future instead of dispatching it again. Both are
+    host-side only: the compiled dispatch programs are untouched, so
+    cache on/off can never retrace.
     """
 
     def __init__(
@@ -212,6 +242,9 @@ class ServingExecutor:
         name: str = "serving",
         registry: "obs_metrics.MetricRegistry | None" = None,
         flight: Optional[FlightRecorder] = None,
+        result_cache: Optional[ResultCache] = None,
+        epoch_fn: Optional[Callable[[], int]] = None,
+        coalesce: Optional[bool] = None,
     ):
         errors.expects(dim >= 1, "ServingExecutor: dim=%d < 1", dim)
         errors.expects(
@@ -262,8 +295,30 @@ class ServingExecutor:
         # never demuxes a PartialSearchResult, and a coverage gauge
         # stuck at its 0.0 initial value would read as total loss
         self._g_coverage: Optional[obs_metrics.Gauge] = None
+        # hot-traffic shaping (ISSUE 15, docs/serving.md "Hot
+        # traffic"): the optional result cache, the mutation-epoch
+        # source (default: constant 0 — a frozen index never goes
+        # stale), and request coalescing (on whenever a cache supplies
+        # the signature scheme, or forced with coalesce=True)
+        self._rcache = result_cache
+        self._epoch_fn: Callable[[], int] = (
+            (lambda: 0) if epoch_fn is None else epoch_fn
+        )
+        self._coalesce_on = (
+            result_cache is not None if coalesce is None else bool(coalesce)
+        )
+        self._c_coalesced = self._registry.counter(
+            "serving_coalesced_total", executor=name)
+        self._sig_leaders: Dict[tuple, tuple] = {}   # key -> (req, epoch)
+        self._coalesced = 0
+        self._cache_hits = 0
         self._req_seq = 0
         self._batch_seq = 0
+        # the epoch every dispatch is stamped with: sampled at init and
+        # re-sampled by set_runtime (the serialization point at which
+        # mutated state becomes visible to later dispatches) — see
+        # docs/serving.md "Hot traffic" for the install ordering rule
+        self._rt_epoch = int(self._epoch_fn())
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)       # batcher wake
@@ -321,6 +376,24 @@ class ServingExecutor:
             "warm a bigger bucket or split the request",
             q.shape[0], self.buckets.largest,
         )
+        # hot-traffic shaping (docs/serving.md "Hot traffic"): a cache
+        # hit or a coalesce answers BEFORE admission — neither consumes
+        # a queue slot or a micro-batch row
+        sigs = None
+        epoch_now = 0
+        if self._rcache is not None or self._coalesce_on:
+            epoch_now = int(self._epoch_fn())
+            sigs = (self._rcache.signatures(q)
+                    if self._rcache is not None
+                    else exact_signatures(q))
+        if self._rcache is not None:
+            cached = self._rcache.lookup(q, epoch=epoch_now, sigs=sigs)
+            if cached is not None:
+                return self._resolve_from_cache(q, cached)
+        if self._coalesce_on:
+            fut = self._try_coalesce(q, sigs, epoch_now)
+            if fut is not None:
+                return fut
         if self.admission is not None:
             try:
                 self.admission.enqueue()   # may shed: RaftOverloadError
@@ -345,10 +418,93 @@ class ServingExecutor:
                 # recorder lock is a leaf — no ordering hazard)
                 self.flight.record("submit", request_id=req.req_id,
                                    rows=int(q.shape[0]))
+            req.sigs = sigs
             self._pending.append(req)
             self._submitted += 1
+            if self._coalesce_on and sigs is not None:
+                # this request becomes the signature's LEADER: later
+                # identical submits (same rows, same epoch) attach as
+                # followers instead of consuming batch rows. The entry
+                # is released (identity-checked) when the request's
+                # batch demuxes or fails — a stale-epoch leader is
+                # simply replaced.
+                key = (int(q.shape[0]), sigs.tobytes())
+                prev = self._sig_leaders.get(key)
+                if prev is None or prev[1] != epoch_now:
+                    self._sig_leaders[key] = (req, epoch_now)
+                    req.sig_key = key
             self._work.notify()
         return fut
+
+    def _resolve_from_cache(self, q: np.ndarray, cached: Any) -> Future:
+        """Resolve a submit straight from the result cache: the future
+        completes before this returns, no queue slot, no batch row."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                errors.fail("submit on a closed ServingExecutor")
+            rid = self._req_seq
+            self._req_seq += 1
+            self._submitted += 1
+            self._completed += 1
+            self._cache_hits += 1
+        if self.flight is not None:
+            self.flight.record("submit", request_id=rid,
+                               rows=int(q.shape[0]))
+            self.flight.record("cache_hit", request_id=rid,
+                               rows=int(q.shape[0]))
+        self._c_completed.inc()
+        fut.set_result(cached)
+        return fut
+
+    def _try_coalesce(self, q: np.ndarray, sigs: np.ndarray,
+                      epoch_now: int) -> Optional[Future]:
+        """Attach this request as a FOLLOWER of an identical in-flight
+        leader (same per-row signatures, same row count, same mutation
+        epoch — an epoch mismatch means a write landed since the leader
+        was submitted, and its answer may be pre-write). The follower's
+        future is resolved from the leader's demuxed BATCH rows, not
+        from the leader's own future — a caller cancelling the leader
+        cancels only itself. Returns None when there is no compatible
+        leader."""
+        key = (int(q.shape[0]), sigs.tobytes())
+        fut: Future = Future()
+        with self._work:
+            if self._closed:
+                return None
+            leader = self._sig_leaders.get(key)
+            if leader is None or leader[1] != epoch_now:
+                return None
+            leader[0].followers.append(fut)
+            rid = self._req_seq
+            self._req_seq += 1
+            self._submitted += 1
+            self._coalesced += 1
+        if self.flight is not None:
+            self.flight.record("submit", request_id=rid,
+                               rows=int(q.shape[0]))
+            self.flight.record("coalesce", request_id=rid,
+                               rows=int(q.shape[0]))
+        self._c_coalesced.inc()
+        return fut
+
+    def _release_followers(self, batch: MicroBatch) -> Dict[int, list]:
+        """Atomically retire the batch's leader registrations and
+        snapshot their followers (by request id). After the map entry
+        is gone no new follower can attach (attachment happens under
+        the same lock), so the snapshot is complete — every follower is
+        resolved exactly once, by whoever demuxes or fails the batch."""
+        subs: Dict[int, list] = {}
+        with self._work:
+            for req, _start in batch.entries:
+                if req.sig_key is not None:
+                    cur = self._sig_leaders.get(req.sig_key)
+                    if cur is not None and cur[0] is req:
+                        del self._sig_leaders[req.sig_key]
+                if req.followers:
+                    subs[req.req_id] = list(req.followers)
+                    req.followers = []
+        return subs
 
     def set_runtime(self, **updates: Any) -> None:
         """Swap runtime-operand values (``shard_mask=``, ``failover=``,
@@ -362,6 +518,13 @@ class ServingExecutor:
                     self._runtime.pop(key, None)
                 else:
                     self._runtime[key] = val
+            # re-sample the mutation epoch WITH the state swap: later
+            # dispatches see the new values and stamp cache fills with
+            # the new epoch atomically. Mutators that hand state to the
+            # dispatch closure by other means call set_runtime() with
+            # no updates after installing it (docs/serving.md "Hot
+            # traffic")
+            self._rt_epoch = int(self._epoch_fn())
         if self.flight is not None:
             # the failover-flip postmortem breadcrumb: a FailoverPlan's
             # route array is tiny and names exactly which replica copy
@@ -424,6 +587,10 @@ class ServingExecutor:
                 in_flight=len(self._inflight),
                 stage_p50_ms=p50,
                 stage_p99_ms=p99,
+                coalesced_requests=self._coalesced,
+                cache_hits=self._cache_hits,
+                cache_stale=(self._rcache.stats().stale
+                             if self._rcache is not None else 0),
             )
 
     def close(self, timeout_s: float = 30.0) -> None:
@@ -488,6 +655,7 @@ class ServingExecutor:
                 batch.batch_id = self._batch_seq
                 self._batch_seq += 1
                 runtime = dict(self._runtime)
+                epoch = self._rt_epoch
                 full = batch.n_padded == 0 and rows >= batch.bucket
             # stage metrics from stamps this loop already holds: the
             # pack wall time, and each packed request's queue wait
@@ -503,13 +671,14 @@ class ServingExecutor:
                         batch_id=batch.batch_id, bucket=batch.bucket,
                         start=start,
                     )
-            self._dispatch_batch(batch, runtime, full)
+            self._dispatch_batch(batch, runtime, full, epoch)
         with self._done:
             self._batcher_exited = True
             self._done.notify_all()
 
     def _dispatch_batch(self, batch: MicroBatch,
-                        runtime: Dict[str, Any], full: bool) -> None:
+                        runtime: Dict[str, Any], full: bool,
+                        epoch: int = 0) -> None:
         # window check OUTSIDE the lock: the batcher blocks here (not
         # the submitters) when max_in_flight programs are queued
         while True:
@@ -550,7 +719,7 @@ class ServingExecutor:
                 self.admission.cancel_queued(batch.n_requests)
             self._fail_batch(batch, exc)
             return
-        fl = _InFlight(batch, out, t0, ticket, runtime)
+        fl = _InFlight(batch, out, t0, ticket, runtime, epoch)
         with self._done:
             self._inflight.append(fl)
             self._batches += 1
@@ -700,10 +869,17 @@ class ServingExecutor:
                     self._g_coverage = self._registry.gauge(
                         "serving_coverage_min", executor=self.name)
                 self._g_coverage.set(cov_min)
+        # retire the batch's coalescing leaders FIRST: once released,
+        # no new follower can attach, so this demux resolves exactly
+        # the snapshot — including followers of a leader whose own
+        # caller cancelled (their rows are right here in the batch)
+        subs = self._release_followers(fl.batch)
         delivered = 0
+        n_followers = 0
         for req, start in fl.batch.entries:
-            if req.future.done():     # caller cancelled while queued
-                continue
+            followers = subs.get(req.req_id, ())
+            if req.future.done() and not followers:
+                continue              # caller cancelled while queued
             rows = slice(start, start + req.n_rows)
             result = compat.tree_map(
                 lambda a, rows=rows: a[rows] if (
@@ -712,16 +888,29 @@ class ServingExecutor:
                 ) else a,
                 host,
             )
-            try:
-                req.future.set_result(result)
-            except InvalidStateError:
-                continue              # cancel raced the done() check
-            delivered += 1
+            if not req.future.done():
+                try:
+                    req.future.set_result(result)
+                    delivered += 1
+                except InvalidStateError:
+                    pass              # cancel raced the done() check
+            for f in followers:
+                try:
+                    f.set_result(result)
+                    n_followers += 1
+                except InvalidStateError:
+                    pass              # the follower's caller cancelled
+            if self._rcache is not None:
+                # fill AFTER resolving the callers (cache writes are
+                # off the latency path), stamped with the DISPATCH
+                # epoch, re-using the submit-time signatures
+                self._cache_fill(req, result, fl.epoch)
         now = self._clock()
         self._hist("demux", bucket).observe((now - t_demux0) * 1e3)
         e2e = self._hist("e2e", bucket)
         for req, _start in fl.batch.entries:
             e2e.observe((now - req.t_arrival) * 1e3)
+        delivered += n_followers
         self._c_completed.inc(delivered)
         if backup_won:
             self._c_backup_wins.inc()
@@ -735,6 +924,34 @@ class ServingExecutor:
         with self._lock:
             self._completed += delivered
             self._backup_wins += int(backup_won)
+
+    def _cache_fill(self, req: PendingRequest, result: Any,
+                    epoch: int) -> None:
+        """Insert one demuxed request into the result cache when the
+        result has the standard search shape — a ``(dists, ids)`` pair
+        of ``(m, k)`` arrays at the cache's k. Anything else (a
+        PartialSearchResult pytree, a mutation-tier triple, a
+        different k) is silently not cached: the cache accelerates the
+        common search path, it never constrains the dispatch contract."""
+        try:
+            if not isinstance(result, (tuple, list)) or len(result) != 2:
+                return
+            dists, ids = result
+            m = req.n_rows
+            k = self._rcache.k
+            if not (isinstance(dists, np.ndarray)
+                    and isinstance(ids, np.ndarray)
+                    and dists.shape == (m, k) and ids.shape == (m, k)
+                    and np.issubdtype(dists.dtype, np.floating)
+                    and np.issubdtype(ids.dtype, np.integer)):
+                return
+            # req.sigs was computed at submit with this cache's salt —
+            # re-using it keeps the per-row hashing off the drain
+            # thread (the serving path's serialization point)
+            self._rcache.insert(req.queries, dists, ids, epoch=epoch,
+                                sigs=req.sigs)
+        except Exception:   # noqa: BLE001 — a cache-write failure must
+            pass            # never fail a delivered request
 
     def _fail_batch(self, batch: MicroBatch, exc: BaseException) -> None:
         if self.flight is not None:
@@ -755,12 +972,20 @@ class ServingExecutor:
                                 # handler: the futures below still owe
                                 # their callers the real exception, and
                                 # an escape would kill the worker thread
+        subs = self._release_followers(batch)
+        n_failed = batch.n_requests
         for req, _ in batch.entries:
             if not req.future.done():
                 try:
                     req.future.set_exception(exc)
                 except InvalidStateError:
                     pass              # cancel raced the done() check
-        self._c_failed.inc(batch.n_requests)
+            for f in subs.get(req.req_id, ()):
+                n_failed += 1
+                try:
+                    f.set_exception(exc)
+                except InvalidStateError:
+                    pass              # the follower's caller cancelled
+        self._c_failed.inc(n_failed)
         with self._lock:
-            self._failed += batch.n_requests
+            self._failed += n_failed

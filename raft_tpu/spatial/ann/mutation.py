@@ -194,6 +194,14 @@ class MutableIndex:
         # on every mutation_* series, so several mutable indexes in one
         # process keep distinct pressure gauges (docs/observability.md)
         self.name: str = "mutable"
+        # the MUTATION EPOCH (ISSUE 15, docs/serving.md "Hot traffic"):
+        # a host-side monotone counter bumped by every APPLIED
+        # upsert/delete batch and by compaction — the result-cache
+        # invalidation input (raft_tpu.serving.result_cache): entries
+        # stamped with an older epoch die on their first post-write
+        # lookup. Host state only; never serialized (a loaded
+        # checkpoint restarts at 0 with an empty cache beside it).
+        self.epoch: int = 0
 
     @property
     def n_lists(self) -> int:
@@ -209,11 +217,13 @@ class MutableIndex:
 
 
 def _with(mindex: MutableIndex, **kw) -> MutableIndex:
-    """dataclasses.replace that PRESERVES the host-side dirty set and
-    telemetry label (``__post_init__`` would reset them)."""
+    """dataclasses.replace that PRESERVES the host-side dirty set,
+    telemetry label, and mutation epoch (``__post_init__`` would reset
+    them; the mutation ops bump the epoch explicitly AFTER _with)."""
     out = dataclasses.replace(mindex, **kw)
     out.dirty_lists = set(mindex.dirty_lists)
     out.name = mindex.name
+    out.epoch = mindex.epoch
     return out
 
 
@@ -411,6 +421,11 @@ def upsert(mindex: MutableIndex, vectors, ids):
     # a superseded delta copy dirties ITS list too — an incremental
     # checkpoint that misses it would resurrect the stale copy on replay
     out.dirty_lists.update(np.nonzero(np.asarray(dirty_sup))[0].tolist())
+    if n_acc:
+        # an APPLIED write bumps the mutation epoch — pre-write cached
+        # results must go stale (docs/serving.md "Hot traffic"); an
+        # all-rejected batch changed nothing and keeps the cache warm
+        out.epoch = mindex.epoch + 1
     return out, accepted_np
 
 
@@ -430,6 +445,10 @@ def delete(mindex: MutableIndex, ids):
     out = _with(mindex, delta=delta, row_mask=row_mask)
     out.dirty_lists.update(np.nonzero(np.asarray(dirty))[0].tolist())
     found_np = np.asarray(found)
+    if bool(found_np.any()):
+        # a delete that actually removed a live row invalidates cached
+        # results, exactly like an applied upsert
+        out.epoch = mindex.epoch + 1
     ms = _mseries(mindex.name)
     ms["op_ms"]["delete"].observe((time.perf_counter() - t0) * 1e3)
     n_found = int(found_np.sum())
@@ -899,6 +918,12 @@ def compact(
     out = wrap_mutable(new_index, delta_cap=mindex.delta.cap,
                        name=mindex.name)
     out.dirty_lists = set(range(nl))   # every list changed on disk
+    # compaction continues (and bumps) the epoch chain: the fold can
+    # re-encode rows and refresh centroids, so pre-compaction cached
+    # results must die exactly like post-upsert ones — and the counter
+    # must not RESET (wrap_mutable starts at 0; a reset would mark old
+    # cache entries fresh again)
+    out.epoch = mindex.epoch + 1
     stats["max_list"] = st.max_list
     stats["n_slab"] = nb
     ms = _mseries(mindex.name)

@@ -16,9 +16,13 @@ process bit-for-bit (the same discipline as
 
 * :func:`poisson_arrivals` — exponential inter-arrival gaps at the
   offered rate (memoryless arrivals — the standard open-loop traffic
-  model), optional per-request size mix;
+  model), optional per-request size mix, optional ZIPF-skewed
+  repeated-query mix (``zipf_s``/``n_templates``: each request draws a
+  template id from a power-law over a query-template pool — the
+  million-user hot-traffic shape the result cache and coalescer are
+  built for, ISSUE 15 / docs/serving.md "Hot traffic");
 * :class:`ArrivalSchedule` — the materialized schedule (offsets +
-  per-request row counts);
+  per-request row counts + optional per-request template ids);
 * :func:`replay` — fire ``submit(i, size)`` at each scheduled instant
   against the wall clock, NEVER waiting on results; when the generator
   falls behind (a stalled submit path) it fires immediately and
@@ -35,7 +39,8 @@ import numpy as np
 
 from raft_tpu import errors
 
-__all__ = ["ArrivalSchedule", "poisson_arrivals", "replay"]
+__all__ = ["ArrivalSchedule", "poisson_arrivals", "replay",
+           "zipf_template_weights"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,11 +50,16 @@ class ArrivalSchedule:
     ``times_s`` are non-decreasing offsets from the replay start;
     ``sizes`` is the per-request query-row count (the executor packs
     them into shape buckets regardless — sizes model the client mix,
-    not the dispatch shape).
-    """
+    not the dispatch shape). ``template_ids`` (optional) is the
+    per-request QUERY-TEMPLATE id of a repeated-query mix
+    (``poisson_arrivals(zipf_s=...)``): the driver maps each id to a
+    fixed query vector from its template pool, so a Zipf-hot template
+    re-arrives as the bitwise-identical query — exactly what the
+    result cache's exact tier and the coalescer key on."""
 
     times_s: np.ndarray   # (n,) float64, non-decreasing, >= 0
     sizes: np.ndarray     # (n,) int64, >= 1
+    template_ids: Optional[np.ndarray] = None   # (n,) int64, >= 0
 
     def __post_init__(self):
         errors.expects(
@@ -69,6 +79,17 @@ class ArrivalSchedule:
             self.times_s.size == 0 or int(self.sizes.min()) >= 1,
             "ArrivalSchedule: sizes must be >= 1",
         )
+        if self.template_ids is not None:
+            errors.expects(
+                self.template_ids.shape == self.times_s.shape,
+                "ArrivalSchedule: template_ids %s must match times %s",
+                self.template_ids.shape, self.times_s.shape,
+            )
+            errors.expects(
+                self.template_ids.size == 0
+                or int(self.template_ids.min()) >= 0,
+                "ArrivalSchedule: template_ids must be >= 0",
+            )
 
     @property
     def n_requests(self) -> int:
@@ -90,9 +111,27 @@ class ArrivalSchedule:
         return self.n_rows / span if span > 0 else float("inf")
 
 
+def zipf_template_weights(n_templates: int, zipf_s: float) -> np.ndarray:
+    """The normalized Zipf(``s``) popularity law over a template pool:
+    ``p(rank i) ∝ (i + 1)^-s``. At s≈1.1 (the classic web-traffic
+    skew) a few head templates carry most of the offered load — the
+    regime where the result cache's hit rate comes from."""
+    errors.expects(n_templates >= 1,
+                   "zipf_template_weights: n_templates=%d < 1",
+                   n_templates)
+    errors.expects(zipf_s >= 0.0,
+                   "zipf_template_weights: zipf_s=%s < 0 is not a "
+                   "popularity skew", zipf_s)
+    w = (np.arange(1, n_templates + 1, dtype=np.float64)
+         ** -float(zipf_s))
+    return w / w.sum()
+
+
 def poisson_arrivals(rate_rps: float, n_requests: int, *, seed: int,
                      sizes: "int | Sequence[int]" = 1,
                      size_weights: Optional[Sequence[float]] = None,
+                     zipf_s: Optional[float] = None,
+                     n_templates: int = 0,
                      ) -> ArrivalSchedule:
     """A seeded Poisson arrival schedule: ``n_requests`` arrivals whose
     inter-arrival gaps are iid Exponential(``rate_rps``) — ``rate_rps``
@@ -100,8 +139,20 @@ def poisson_arrivals(rate_rps: float, n_requests: int, *, seed: int,
 
     ``sizes``: a constant per-request row count, or a sequence to
     sample from (optionally ``size_weights``-weighted) — the client
-    mix. Fully deterministic in ``(rate_rps, n_requests, seed, sizes,
-    size_weights)``.
+    mix.
+
+    ``zipf_s`` (with ``n_templates``): the REPEATED-QUERY mix
+    (ISSUE 15) — each request additionally draws a template id from
+    :func:`zipf_template_weights` over a pool of ``n_templates`` query
+    templates, landed in ``template_ids``. The driver maps ids to
+    fixed query vectors, so hot templates recur bitwise-identically —
+    realistic Zipf-skewed traffic for the result cache / coalescing
+    bench (``zipf_hot_traffic``).
+
+    Fully deterministic in ``(rate_rps, n_requests, seed, sizes,
+    size_weights, zipf_s, n_templates)`` — the template draw happens
+    AFTER the gap and size draws on the same stream, so adding the mix
+    never perturbs an existing schedule's times or sizes.
     """
     errors.expects(rate_rps > 0, "poisson_arrivals: rate_rps=%s <= 0",
                    rate_rps)
@@ -120,7 +171,17 @@ def poisson_arrivals(rate_rps: float, n_requests: int, *, seed: int,
             p = np.asarray(list(size_weights), np.float64)
             p = p / p.sum()
         sz = rng.choice(choices, size=n_requests, p=p)
-    return ArrivalSchedule(times_s=times, sizes=sz)
+    tmpl = None
+    if zipf_s is not None:
+        errors.expects(
+            n_templates >= 1,
+            "poisson_arrivals: zipf_s=%s needs n_templates >= 1 (the "
+            "query-template pool the skew is drawn over)", zipf_s,
+        )
+        w = zipf_template_weights(n_templates, zipf_s)
+        tmpl = rng.choice(np.arange(n_templates, dtype=np.int64),
+                          size=n_requests, p=w)
+    return ArrivalSchedule(times_s=times, sizes=sz, template_ids=tmpl)
 
 
 def replay(schedule: ArrivalSchedule,

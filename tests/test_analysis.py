@@ -1355,6 +1355,67 @@ FIXTURE_BAD = textwrap.dedent("""
 """)
 
 
+def test_stale_epoch_read_flags_missing_epoch():
+    out = findings("""
+        def serve(result_cache, rows):
+            return result_cache.lookup(rows)
+    """, rule="stale-epoch-read")
+    assert len(out) == 1
+    assert "threads no mutation epoch" in out[0].message
+
+
+def test_stale_epoch_read_flags_literal_epoch():
+    out = findings("""
+        def serve(self, rows):
+            a = self._rcache.lookup(rows, epoch=0)
+            b = self._rcache.lookup(rows, epoch=None)
+    """, rule="stale-epoch-read")
+    assert len(out) == 2
+    assert all("pins the mutation epoch" in f.message for f in out)
+
+
+def test_stale_epoch_read_threaded_epoch_clean():
+    # a name, an attribute chain, or an epoch-returning call all count
+    # as threading a live epoch
+    out = findings("""
+        def serve(self, cache, rows, epoch):
+            a = cache.lookup(rows, epoch=epoch)
+            b = cache.lookup(rows, epoch=self._rt_epoch)
+            c = cache.lookup(rows, epoch=mindex.epoch)
+            d = cache.lookup(rows, epoch=epoch_fn())
+            e = cache.lookup(rows, int(current_epoch))
+    """, rule="stale-epoch-read")
+    assert out == []
+
+
+def test_stale_epoch_read_epochish_receiver_still_flagged():
+    # the receiver's own name never counts as threading an epoch —
+    # `epoch_cache.lookup(rows)` is exactly the bypass
+    out = findings("""
+        def serve(epoch_cache, rows):
+            return epoch_cache.lookup(rows)
+    """, rule="stale-epoch-read")
+    assert len(out) == 1
+
+
+def test_stale_epoch_read_non_cache_receiver_clean():
+    # only cache-shaped receivers are result-cache lookups
+    out = findings("""
+        def resolve(registry, dns, name):
+            a = registry.lookup(name)
+            b = dns.lookup(name)
+    """, rule="stale-epoch-read")
+    assert out == []
+
+
+def test_stale_epoch_read_suppression_honored():
+    out = findings("""
+        def serve(frozen_cache, rows):
+            return frozen_cache.lookup(rows, epoch=0)  # jaxlint: disable=stale-epoch-read
+    """, rule="stale-epoch-read")
+    assert out == []
+
+
 def test_baseline_respected_and_counted(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(FIXTURE_BAD)

@@ -106,6 +106,47 @@ class TestPoissonLoad:
                                    size_weights=(0.75, 0.25))
         np.testing.assert_array_equal(s.sizes, s2.sizes)
 
+    def test_zipf_template_mix_deterministic_and_skewed(self):
+        """ISSUE 15 satellite: the Zipf repeated-query mix — seeded
+        template ids over a pool, head-heavy at s=1.1, and adding the
+        mix never perturbs the schedule's times or sizes."""
+        s = load.poisson_arrivals(10.0, 400, seed=3, zipf_s=1.1,
+                                  n_templates=16)
+        s2 = load.poisson_arrivals(10.0, 400, seed=3, zipf_s=1.1,
+                                   n_templates=16)
+        np.testing.assert_array_equal(s.template_ids, s2.template_ids)
+        assert s.template_ids.min() >= 0
+        assert s.template_ids.max() < 16
+        # the same seed without a mix gives the identical arrivals
+        base = load.poisson_arrivals(10.0, 400, seed=3)
+        np.testing.assert_array_equal(s.times_s, base.times_s)
+        np.testing.assert_array_equal(s.sizes, base.sizes)
+        assert base.template_ids is None
+        # Zipf(1.1) over 16 templates: rank-0 carries the head (~29%
+        # expected; generous band for the 400-draw sample)
+        share0 = float((s.template_ids == 0).mean())
+        assert share0 > 2.0 / 16
+        # weights are the normalized power law, monotone decreasing
+        w = load.zipf_template_weights(16, 1.1)
+        assert w.shape == (16,) and w.sum() == pytest.approx(1.0)
+        assert (np.diff(w) < 0).all()
+
+    def test_zipf_mix_validation(self):
+        with pytest.raises(ValueError):
+            load.poisson_arrivals(1.0, 4, seed=0, zipf_s=1.1)
+        with pytest.raises(ValueError):
+            load.zipf_template_weights(0, 1.1)
+        with pytest.raises(ValueError):
+            load.ArrivalSchedule(
+                times_s=np.zeros(2), sizes=np.ones(2, np.int64),
+                template_ids=np.zeros(3, np.int64),
+            )
+        with pytest.raises(ValueError):
+            load.ArrivalSchedule(
+                times_s=np.zeros(2), sizes=np.ones(2, np.int64),
+                template_ids=np.array([0, -1]),
+            )
+
     def test_replay_open_loop_never_waits_on_results(self):
         """Replay with a virtual clock: each submit fires at its
         scheduled instant; a slow submit makes the NEXT one fire

@@ -1,0 +1,789 @@
+"""Hot-traffic shaping suite (ISSUE 15, docs/serving.md "Hot traffic"):
+the semantic result cache, request coalescing, mutation-epoch
+invalidation, and popularity-aware replication — all on CPU with tiny
+indexes, asserting BEHAVIOR (a stale entry can never serve, a coalesced
+caller gets exactly its rows, route flips stay runtime values), never
+QPS. Also the direct :class:`raft_tpu.cache.VectorCache` coverage the
+cache had been missing (it was only exercised through
+test_label_lap_cache_spectral.py). Runs fail-fast in ci/run.sh next to
+the obs smoke: the cache fronts every serving dispatch, so a
+correctness bug here poisons every later serving measurement."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.cache import VectorCache
+from raft_tpu.resilience import (
+    FailoverPlan,
+    HedgePolicy,
+    ReplicaPlacement,
+    measured_shard_load,
+    popularity_replication,
+    record_shard_load,
+)
+from raft_tpu.obs import metrics as obsm
+from raft_tpu.obs.flight import FlightRecorder
+from raft_tpu.serving import (
+    CentroidSigner,
+    ExecutorStats,
+    ResultCache,
+    ServingExecutor,
+    semantic_recall,
+)
+from raft_tpu.serving.result_cache import exact_signatures
+from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+from raft_tpu.spatial.ann.ivf_flat import (
+    _grouped_impl,
+    ivf_flat_search_grouped,
+)
+from raft_tpu.spatial.ann.mutation import (
+    compact,
+    delete as mut_delete,
+    mutable_search,
+    mutable_warmup,
+    upsert as mut_upsert,
+    wrap_mutable,
+)
+from raft_tpu.testing import faults
+
+D = 8
+K = 4
+N_PROBES = 4
+
+
+# ------------------------------------------------- VectorCache, directly
+class TestVectorCache:
+    def test_round_trip_and_found_mask(self):
+        c = VectorCache(3, n_sets=4, associativity=2)
+        c.store_vecs([1, 2], np.array([[1., 2., 3.], [4., 5., 6.]],
+                                      np.float32))
+        vecs, found = c.get_vecs([1, 2, 9])
+        assert np.asarray(found).tolist() == [True, True, False]
+        np.testing.assert_array_equal(np.asarray(vecs)[0], [1., 2., 3.])
+        np.testing.assert_array_equal(np.asarray(vecs)[2], 0.0)
+        assert c.n_cached == 2
+
+    def test_associativity_collision_evicts_lru(self):
+        """Three keys in ONE set of a 2-way cache: the least-recently
+        USED lane is the victim (a get touches its entry's clock)."""
+        c = VectorCache(1, n_sets=2, associativity=2)
+        c.store_vecs([0], np.array([[10.0]], np.float32))   # set 0
+        c.store_vecs([2], np.array([[12.0]], np.float32))   # set 0
+        _ = c.get_vecs([0])       # touch key 0 -> key 2 is now LRU
+        c.store_vecs([4], np.array([[14.0]], np.float32))   # evicts 2
+        _, found = c.get_vecs([0, 2, 4])
+        assert np.asarray(found).tolist() == [True, False, True]
+
+    def test_insertion_order_eviction_without_touch(self):
+        c = VectorCache(1, n_sets=2, associativity=2)
+        c.store_vecs([0], np.array([[10.0]], np.float32))
+        c.store_vecs([2], np.array([[12.0]], np.float32))
+        c.store_vecs([4], np.array([[14.0]], np.float32))   # evicts 0
+        _, found = c.get_vecs([0, 2, 4])
+        assert np.asarray(found).tolist() == [False, True, True]
+
+    def test_same_set_distinct_keys_one_call_all_stored(self):
+        """Distinct keys colliding on one SET within a single
+        store_vecs call claim distinct LRU lanes (the reference
+        assign_cache_idx contract) — the old same-victim overwrite
+        silently dropped a row, which made a colliding request
+        permanently uncacheable in the result cache."""
+        c = VectorCache(1, n_sets=2, associativity=4)
+        keys = np.array([0, 2, 4, 6])              # all map to set 0
+        c.store_vecs(keys, np.arange(4, dtype=np.float32)[:, None])
+        vecs, found = c.get_vecs(keys)
+        assert np.asarray(found).all()
+        np.testing.assert_array_equal(
+            np.asarray(vecs).ravel(), [0.0, 1.0, 2.0, 3.0])
+        # beyond the associativity the ranks wrap (still a cache, no
+        # crash; the overflowed rows overwrite from the LRU end)
+        c2 = VectorCache(1, n_sets=2, associativity=2)
+        c2.store_vecs(np.array([0, 2, 4]),
+                      np.arange(3, dtype=np.float32)[:, None])
+        _, f2 = c2.get_vecs(np.array([0, 2, 4]))
+        assert np.asarray(f2).sum() == 2
+
+    def test_evict_absent_key_is_noop(self):
+        c = VectorCache(2, n_sets=4, associativity=2)
+        c.store_vecs([3], np.array([[1.0, 2.0]], np.float32))
+        c.evict([7])               # same set as 3, absent
+        c.evict([100])             # different set, absent
+        vecs, found = c.get_vecs([3])
+        assert bool(np.asarray(found)[0])
+        np.testing.assert_array_equal(np.asarray(vecs)[0], [1.0, 2.0])
+        c.evict([3])
+        _, found = c.get_vecs([3])
+        assert not bool(np.asarray(found)[0])
+        assert c.n_cached == 0
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32,
+                                       jnp.bfloat16])
+    def test_dtype_round_trip(self, dtype):
+        c = VectorCache(4, n_sets=4, associativity=2, dtype=dtype)
+        if dtype == jnp.int32:
+            v = np.array([[-(2 ** 31) + 5, -1, 0, 2 ** 31 - 1]],
+                         np.int32)
+        elif dtype == jnp.bfloat16:
+            v = np.array([[1.0, -2.0, 0.5, 128.0]], np.float32)
+        else:
+            v = np.array([[1e-38, -np.inf, 3.5, 1e38]], np.float32)
+        c.store_vecs([5], jnp.asarray(v, dtype))
+        out, found = c.get_vecs([5])
+        assert bool(np.asarray(found)[0])
+        assert out.dtype == jnp.dtype(dtype)
+        np.testing.assert_array_equal(
+            np.asarray(out, np.float64 if dtype != jnp.int32 else None),
+            np.asarray(jnp.asarray(v, dtype), out.dtype),
+        )
+
+    def test_shape_round_trip_and_update_in_place(self):
+        c = VectorCache(2, n_sets=2, associativity=2)
+        c.store_vecs([1], np.array([[1.0, 2.0]], np.float32))
+        c.store_vecs([1], np.array([[9.0, 8.0]], np.float32))  # update
+        vecs, _ = c.get_vecs([1])
+        assert np.asarray(vecs).shape == (1, 2)
+        np.testing.assert_array_equal(np.asarray(vecs)[0], [9.0, 8.0])
+        assert c.n_cached == 1     # updated the slot, not a second one
+
+
+# --------------------------------------------------------- signatures
+class TestSignatures:
+    def test_exact_signature_content_keyed(self):
+        rng = np.random.default_rng(0)
+        q = rng.standard_normal((4, D)).astype(np.float32)
+        s1 = exact_signatures(q)
+        s2 = exact_signatures(q.copy())
+        np.testing.assert_array_equal(s1, s2)
+        s3 = exact_signatures(q + 1e-7)       # any bit flip re-keys
+        assert not np.array_equal(s1, s3)
+        assert not np.array_equal(exact_signatures(q, b"k4"),
+                                  exact_signatures(q, b"k8"))
+
+    def test_centroid_signer_sorted_and_stable(self):
+        rng = np.random.default_rng(1)
+        sc = rng.standard_normal((16, D)).astype(np.float32)
+        signer = CentroidSigner(sc, n_probes=3)
+        q = rng.standard_normal((5, D)).astype(np.float32)
+        ids = signer.super_ids(q)
+        assert ids.shape == (5, 3)
+        assert (np.diff(ids, axis=1) > 0).all()     # sorted, distinct
+        np.testing.assert_array_equal(ids, signer.super_ids(q.copy()))
+        # a tiny perturbation keeps the semantic signature
+        np.testing.assert_array_equal(
+            signer(q), signer(q + 1e-6))
+
+    def test_signer_n_probes_clamped(self):
+        sc = np.eye(3, D, dtype=np.float32)
+        signer = CentroidSigner(sc, n_probes=10)
+        assert signer.super_ids(np.zeros((1, D), np.float32)).shape == \
+            (1, 3)
+
+
+# ------------------------------------------------------- ResultCache unit
+def _mk_results(m, k=K, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((m, k)).astype(np.float32),
+            rng.integers(0, 10 ** 6, (m, k)).astype(np.int32))
+
+
+class TestResultCache:
+    def test_insert_lookup_exact_round_trip(self):
+        rc = ResultCache(K, n_sets=32, name="t_rt",
+                         registry=obsm.MetricRegistry())
+        rng = np.random.default_rng(2)
+        q = rng.standard_normal((3, D)).astype(np.float32)
+        d, i = _mk_results(3)
+        d[0, 0] = np.inf           # distance BITS round-trip exactly
+        d[1, 1] = 1e-38
+        assert rc.lookup(q, epoch=0) is None
+        rc.insert(q, d, i, epoch=0)
+        out = rc.lookup(q, epoch=0)
+        assert out is not None
+        np.testing.assert_array_equal(out[0], d)
+        np.testing.assert_array_equal(out[1], i)
+        st = rc.stats()
+        assert st.hits == 3 and st.misses == 3 and st.inserts == 3
+        assert st.hit_rate == pytest.approx(0.5)
+
+    def test_epoch_mismatch_is_stale_then_evicted(self):
+        rc = ResultCache(K, n_sets=32, name="t_epoch",
+                         registry=obsm.MetricRegistry())
+        q = np.ones((2, D), np.float32)
+        d, i = _mk_results(2)
+        rc.insert(q, d, i, epoch=3)
+        assert rc.lookup(q, epoch=3) is not None
+        assert rc.lookup(q, epoch=4) is None        # stale
+        st = rc.stats()
+        assert st.stale == 2
+        # the stale entries died: a second epoch-4 lookup is a clean
+        # miss (no second stale count), and re-inserting at 4 serves
+        assert rc.lookup(q, epoch=4) is None
+        assert rc.stats().stale == 2
+        rc.insert(q, d, i, epoch=4)
+        assert rc.lookup(q, epoch=4) is not None
+
+    def test_partial_hit_is_a_miss(self):
+        rc = ResultCache(K, n_sets=32, name="t_part",
+                         registry=obsm.MetricRegistry())
+        rng = np.random.default_rng(3)
+        q = rng.standard_normal((2, D)).astype(np.float32)
+        d, i = _mk_results(2)
+        rc.insert(q[:1], d[:1], i[:1], epoch=0)
+        assert rc.lookup(q, epoch=0) is None       # row 1 missing
+
+    def test_insert_shape_validated(self):
+        rc = ResultCache(K, name="t_shape",
+                         registry=obsm.MetricRegistry())
+        q = np.ones((2, D), np.float32)
+        d, i = _mk_results(2, k=K + 1)
+        with pytest.raises(ValueError):
+            rc.insert(q, d, i, epoch=0)
+
+    def test_semantic_tier_gated_and_served(self):
+        rng = np.random.default_rng(4)
+        sc = rng.standard_normal((8, D)).astype(np.float32)
+        signer = CentroidSigner(sc, n_probes=2)
+        rc = ResultCache(K, n_sets=32, signer=signer, name="t_sem",
+                         registry=obsm.MetricRegistry())
+        q = rng.standard_normal((2, D)).astype(np.float32)
+        near = q + 1e-5            # same super ids, different bytes
+        assert np.array_equal(signer(q), signer(near))
+        d, i = _mk_results(2)
+        rc.insert(q, d, i, epoch=0)
+        # disabled by default: near-duplicate misses
+        assert not rc.semantic_enabled
+        assert rc.lookup(near, epoch=0) is None
+        rc.semantic_enabled = True
+        out = rc.lookup(near, epoch=0)
+        assert out is not None
+        np.testing.assert_array_equal(out[1], i)
+        assert rc.stats().semantic_hits == 2
+        # epoch invalidation applies to the semantic tier too
+        assert rc.lookup(near, epoch=1) is None
+
+    def test_calibrate_semantic_guardrail(self):
+        rng = np.random.default_rng(5)
+        sc = rng.standard_normal((4, D)).astype(np.float32)
+        signer = CentroidSigner(sc, n_probes=1)
+
+        def search_same(rows):
+            m = rows.shape[0]
+            ids = np.tile(np.arange(K, dtype=np.int32), (m, 1))
+            return np.zeros((m, K), np.float32), ids
+
+        rc = ResultCache(K, signer=signer, name="t_cal",
+                         registry=obsm.MetricRegistry())
+        # colliding queries whose fresh results agree -> recall 1.0
+        base = rng.standard_normal((1, D)).astype(np.float32)
+        sample = np.concatenate([base + 1e-5 * j for j in range(4)])
+        assert rc.calibrate_semantic(sample, search_same) is True
+        assert rc.measured_semantic_recall == pytest.approx(1.0)
+        assert rc.semantic_enabled
+
+        def search_disjoint(rows):
+            m = rows.shape[0]
+            ids = (np.arange(m, dtype=np.int32)[:, None] * K
+                   + np.arange(K, dtype=np.int32)[None, :])
+            return np.zeros((m, K), np.float32), ids
+
+        rc2 = ResultCache(K, signer=signer, name="t_cal2",
+                          registry=obsm.MetricRegistry())
+        assert rc2.calibrate_semantic(sample, search_disjoint) is False
+        assert rc2.measured_semantic_recall == pytest.approx(0.0)
+        assert not rc2.semantic_enabled
+        # no colliding pair in the sample: recall unmeasurable, OFF
+        spread = np.asarray(sc) * 100.0
+        rc3 = ResultCache(K, signer=signer, name="t_cal3",
+                          registry=obsm.MetricRegistry())
+        assert rc3.calibrate_semantic(spread, search_same) is False
+        assert rc3.measured_semantic_recall is None
+
+    def test_semantic_recall_helper_counts_pairs(self):
+        sc = np.eye(2, D, dtype=np.float32)
+        signer = CentroidSigner(sc, n_probes=1)
+        q = np.stack([sc[0], sc[0] * 1.001, sc[1]]).astype(np.float32)
+
+        def search(rows):
+            m = rows.shape[0]
+            return (np.zeros((m, K), np.float32),
+                    np.tile(np.arange(K, dtype=np.int32), (m, 1)))
+
+        r = semantic_recall(q, search, signer, K)
+        assert r == pytest.approx(1.0)
+
+    def test_counters_land_in_registry(self):
+        reg = obsm.MetricRegistry()
+        rc = ResultCache(K, name="t_reg", registry=reg)
+        q = np.ones((1, D), np.float32)
+        d, i = _mk_results(1)
+        rc.lookup(q, epoch=0)
+        rc.insert(q, d, i, epoch=0)
+        rc.lookup(q, epoch=0)
+        vals = {
+            tuple(sorted(s.labels.items())): s.value
+            for s in reg.series("serving_result_cache_total")
+        }
+        assert vals[(("cache", "t_reg"), ("result", "hit"))] == 1
+        assert vals[(("cache", "t_reg"), ("result", "miss"))] == 1
+
+
+# --------------------------------------------- executor: cache + coalesce
+@pytest.fixture(scope="module")
+def tiny_serving():
+    """A tiny warmed IVF-Flat serving setup at one shared qcap (the
+    test_open_loop fixture recipe, rebuilt here so this suite stays
+    importable fail-fast on its own)."""
+    rng = np.random.default_rng(17)
+    x = rng.standard_normal((2048, D)).astype(np.float32)
+    idx = ivf_flat_build(x, IVFFlatParams(n_lists=8, kmeans_n_iters=3,
+                                          seed=2))
+    qcap = 32
+    for b in (4, 8):
+        idx.warmup(b, k=K, n_probes=N_PROBES, qcap=qcap)
+
+    def dispatch(batch, **_rt):
+        return ivf_flat_search_grouped(
+            idx, batch, K, n_probes=N_PROBES, qcap=qcap,
+        )
+
+    q = rng.standard_normal((32, D)).astype(np.float32)
+    return idx, dispatch, q
+
+
+def _wait(pred, timeout_s=10.0):
+    t0 = time.monotonic()
+    while not pred():
+        assert time.monotonic() - t0 < timeout_s, "timed out"
+        time.sleep(0.002)
+
+
+class TestExecutorResultCache:
+    def test_repeat_query_served_from_cache_zero_retrace(self,
+                                                         tiny_serving):
+        """The hot-query path: an identical re-submit is answered from
+        the cache with the bitwise result of the first dispatch, no new
+        batch, no new compile (cache on/off touches no program)."""
+        idx, dispatch, q, = tiny_serving
+        warmed = _grouped_impl._cache_size()
+        rc = ResultCache(K, name="ex_hit", registry=obsm.MetricRegistry())
+        ex = ServingExecutor(dispatch, (4, 8), dim=D, flush_age_s=0.0,
+                             result_cache=rc)
+        r1 = ex.submit(q[:2]).result(timeout=30)
+        _wait(lambda: rc.stats().inserts >= 2)
+        r2 = ex.submit(q[:2]).result(timeout=30)
+        np.testing.assert_array_equal(np.asarray(r1[0]), r2[0])
+        np.testing.assert_array_equal(np.asarray(r1[1]), r2[1])
+        ex.close()
+        st = ex.stats()
+        assert st.cache_hits == 1 and st.batches == 1
+        assert st.completed == 2
+        assert _grouped_impl._cache_size() == warmed, \
+            "the result cache must never touch the compiled programs"
+
+    def test_cache_hit_and_coalesce_flight_events(self, tiny_serving):
+        idx, dispatch, q = tiny_serving
+        gate = threading.Event()
+
+        def gated(batch, **rt):
+            gate.wait(10.0)
+            return dispatch(batch)
+
+        fl = FlightRecorder(capacity=256)
+        rc = ResultCache(K, name="ex_fl", registry=obsm.MetricRegistry())
+        ex = ServingExecutor(gated, (4, 8), dim=D, flush_age_s=0.0,
+                             result_cache=rc, flight=fl)
+        lead = ex.submit(q[:2])
+        _wait(lambda: len(ex._pending) == 0)   # packed (gate holds it)
+        follow = ex.submit(q[:2])          # identical -> coalesce
+        gate.set()
+        lead.result(timeout=30)
+        follow.result(timeout=30)
+        _wait(lambda: rc.stats().inserts >= 2)
+        hit = ex.submit(q[:2])
+        hit.result(timeout=30)
+        ex.close()
+        assert len(fl.events(event="coalesce")) == 1
+        assert len(fl.events(event="cache_hit")) == 1
+        st = ex.stats()
+        assert st.coalesced_requests == 1 and st.cache_hits == 1
+
+    def test_coalesced_rows_correct_and_no_extra_batch(self,
+                                                      tiny_serving):
+        idx, dispatch, q = tiny_serving
+        gate = threading.Event()
+
+        def gated(batch, **rt):
+            gate.wait(10.0)
+            return dispatch(batch)
+
+        ex = ServingExecutor(gated, (4, 8), dim=D, flush_age_s=0.0,
+                             result_cache=ResultCache(
+                                 K, name="ex_co",
+                                 registry=obsm.MetricRegistry()))
+        lead = ex.submit(q[4:6])
+        _wait(lambda: len(ex._pending) == 0)   # packed (gate holds it)
+        f1 = ex.submit(q[4:6])
+        f2 = ex.submit(q[4:6])
+        gate.set()
+        ref = lead.result(timeout=30)
+        for f in (f1, f2):
+            out = f.result(timeout=30)
+            np.testing.assert_array_equal(np.asarray(ref[1]), out[1])
+        ex.close()
+        st = ex.stats()
+        assert st.batches == 1 and st.coalesced_requests == 2
+        assert st.completed == 3
+
+    def test_coalesce_requires_same_rows_and_epoch(self, tiny_serving):
+        """A different query, a different row count, or a bumped epoch
+        must NOT coalesce onto the in-flight leader."""
+        idx, dispatch, q = tiny_serving
+        gate = threading.Event()
+        epoch = [0]
+
+        def gated(batch, **rt):
+            gate.wait(10.0)
+            return dispatch(batch)
+
+        ex = ServingExecutor(gated, (4, 8), dim=D, flush_age_s=0.0,
+                             coalesce=True, epoch_fn=lambda: epoch[0])
+        lead = ex.submit(q[:2])
+        _wait(lambda: len(ex._pending) == 0)   # packed (gate holds it)
+        other = ex.submit(q[2:4])          # different bytes
+        epoch[0] = 1
+        post_write = ex.submit(q[:2])      # same bytes, NEWER epoch
+        gate.set()
+        for f in (lead, other, post_write):
+            f.result(timeout=30)
+        ex.close()
+        st = ex.stats()
+        assert st.coalesced_requests == 0
+        assert st.batches >= 2
+
+    def test_follower_survives_leader_cancellation(self, tiny_serving):
+        """A caller cancelling the LEADER's future cancels only
+        itself: followers are resolved from the demuxed batch rows,
+        not from the leader's future."""
+        idx, dispatch, q = tiny_serving
+        gate = threading.Event()
+
+        def gated(batch, **rt):
+            gate.wait(10.0)
+            return dispatch(batch)
+
+        ex = ServingExecutor(gated, (4, 8), dim=D, flush_age_s=0.0,
+                             coalesce=True)
+        ref = np.asarray(dispatch(jnp.asarray(
+            np.vstack([q[:2], np.zeros((2, D), np.float32)])))[1])[:2]
+        lead = ex.submit(q[:2])
+        _wait(lambda: len(ex._pending) == 0)   # packed (gate holds it)
+        follow = ex.submit(q[:2])
+        assert lead.cancel()
+        gate.set()
+        out = follow.result(timeout=30)
+        ex.close()
+        np.testing.assert_array_equal(np.asarray(out[1]), ref)
+        st = ex.stats()
+        assert st.coalesced_requests == 1
+        assert st.completed == 1 and st.failed == 0
+
+    def test_coalesced_follower_gets_leader_failure(self, tiny_serving):
+        idx, dispatch, q = tiny_serving
+        gate = threading.Event()
+
+        def doomed(batch, **rt):
+            gate.wait(10.0)
+            raise RuntimeError("boom")
+
+        ex = ServingExecutor(doomed, (4, 8), dim=D, flush_age_s=0.0,
+                             coalesce=True)
+        lead = ex.submit(q[:2])
+        _wait(lambda: len(ex._pending) == 0)
+        follow = ex.submit(q[:2])
+        gate.set()
+        with pytest.raises(RuntimeError, match="boom"):
+            lead.result(timeout=30)
+        with pytest.raises(RuntimeError, match="boom"):
+            follow.result(timeout=30)
+        ex.close()
+        st = ex.stats()
+        assert st.failed == 2
+
+    def test_executor_stats_byte_compatible(self):
+        """Pre-r15 positional constructions (12 args, then the r13
+        stage dicts) still work; the new fields default to 0."""
+        st = ExecutorStats(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+        assert st.submitted == 1 and st.in_flight == 12
+        assert st.coalesced_requests == 0
+        assert st.cache_hits == 0 and st.cache_stale == 0
+        st2 = ExecutorStats(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
+                            {}, {})
+        assert st2.stage_p50_ms == {}
+
+
+# --------------------------------------- mutation-epoch chaos (acceptance)
+@pytest.fixture()
+def mutable_serving():
+    rng = np.random.default_rng(23)
+    x = rng.standard_normal((512, D)).astype(np.float32)
+    idx = ivf_flat_build(x, IVFFlatParams(n_lists=4, kmeans_n_iters=3,
+                                          seed=3))
+    mw = wrap_mutable(idx, delta_cap=8)
+    qcap = 8
+    for b in (4,):
+        mutable_warmup(mw, b, k=K, n_probes=N_PROBES, qcap=qcap)
+    cell = {"m": mw}
+
+    def dispatch(batch, **_rt):
+        return mutable_search(cell["m"], batch, K, n_probes=N_PROBES,
+                              qcap=qcap)
+
+    return cell, dispatch, x
+
+
+class TestMutationEpochChaos:
+    def test_epoch_bumps_on_applied_mutations_only(self, mutable_serving):
+        cell, dispatch, x = mutable_serving
+        m0 = cell["m"]
+        assert m0.epoch == 0
+        m1, acc = mut_upsert(m0, x[:1] * 1.5, np.array([900], np.int32))
+        assert bool(acc[0]) and m1.epoch == 1
+        # a no-op delete (missing id) does not bump
+        m2, found = mut_delete(m1, np.array([123456], np.int32))
+        assert not bool(found[0]) and m2.epoch == 1
+        m3, found = mut_delete(m2, np.array([900], np.int32))
+        assert bool(found[0]) and m3.epoch == 2
+        # a rejected upsert (negative id) is a strict no-op
+        m4, acc = mut_upsert(m3, x[:1], np.array([-1], np.int32))
+        assert not bool(acc[0]) and m4.epoch == 2
+        m5, _ = compact(m3)
+        assert m5.epoch == 3       # continues the chain, never resets
+
+    def test_write_between_identical_queries_never_serves_stale(
+            self, mutable_serving):
+        """THE chaos acceptance: an upsert (and later a delete) lands
+        between two identical queries — the second query must see the
+        post-write truth, through the cache, via delta-apply AND
+        compaction."""
+        cell, dispatch, x = mutable_serving
+        rc = ResultCache(K, name="chaos",
+                         registry=obsm.MetricRegistry())
+        ex = ServingExecutor(
+            dispatch, (4,), dim=D, flush_age_s=0.0,
+            result_cache=rc, epoch_fn=lambda: cell["m"].epoch,
+        )
+        probe = (x[:1] * 1.01).astype(np.float32)
+        r0 = ex.submit(probe).result(timeout=30)
+        assert 777 not in np.asarray(r0[1]).tolist()[0]
+        _wait(lambda: rc.stats().inserts >= 1)
+        # warm hit proves the entry is live before the write
+        ex.submit(probe).result(timeout=30)
+        assert ex.stats().cache_hits == 1
+
+        # -- delta-apply: upsert the probe itself under id 777
+        cell["m"], acc = mut_upsert(cell["m"], probe,
+                                    np.array([777], np.int32))
+        assert bool(acc[0])
+        ex.set_runtime()           # install: re-samples the epoch
+        r1 = ex.submit(probe).result(timeout=30)
+        assert int(np.asarray(r1[1])[0, 0]) == 777, \
+            "post-upsert query served a pre-write cached result"
+        assert ex.stats().cache_hits == 1       # NOT a cache hit
+        assert rc.stats().stale >= 1
+
+        # -- delete: the id must vanish from the next identical query
+        _wait(lambda: rc.stats().inserts >= 2)
+        cell["m"], found = mut_delete(cell["m"],
+                                      np.array([777], np.int32))
+        assert bool(found[0])
+        ex.set_runtime()
+        r2 = ex.submit(probe).result(timeout=30)
+        assert 777 not in np.asarray(r2[1]).tolist()[0], \
+            "post-delete query served a pre-write cached result"
+
+        # -- compaction: also an epoch bump -> also invalidates
+        _wait(lambda: rc.stats().inserts >= 3)
+        hits_before = ex.stats().cache_hits
+        cell["m"], _ = compact(cell["m"], list_bucket=4, row_bucket=64)
+        mutable_warmup(cell["m"], 4, k=K, n_probes=N_PROBES, qcap=8)
+        ex.set_runtime()
+        r3 = ex.submit(probe).result(timeout=30)
+        ex.close()
+        assert ex.stats().cache_hits == hits_before, \
+            "post-compaction query hit a pre-compaction cache entry"
+        np.testing.assert_array_equal(np.asarray(r2[1]),
+                                      np.asarray(r3[1]))
+
+    def test_coalesced_under_straggler_and_hedge_all_complete(
+            self, tiny_serving):
+        """Coalesced requests + a straggling primary + a hedged backup:
+        every caller (leader and followers) still gets its correct
+        rows, exactly once."""
+        idx, dispatch, q = tiny_serving
+        wrapped, audit = faults.inject_straggler(
+            dispatch, every=1, seconds=30.0,
+        )
+        pol = HedgePolicy(default_delay_s=0.02, min_samples=10 ** 6)
+        rc = ResultCache(K, name="hedge_co",
+                         registry=obsm.MetricRegistry())
+        ex = ServingExecutor(
+            wrapped, (4, 8), dim=D, flush_age_s=0.0,
+            hedge=pol, backup_dispatch=dispatch, result_cache=rc,
+        )
+        ref = np.asarray(dispatch(jnp.asarray(
+            np.vstack([q[:2], np.zeros((2, D), np.float32)])))[1])[:2]
+        lead = ex.submit(q[:2])
+        _wait(lambda: ex.stats().in_flight >= 1)
+        f1 = ex.submit(q[:2])
+        f2 = ex.submit(q[:2])
+        outs = [f.result(timeout=30) for f in (lead, f1, f2)]
+        ex.close()
+        for out in outs:
+            np.testing.assert_array_equal(np.asarray(out[1]), ref)
+        st = ex.stats()
+        assert st.hedged_batches == 1 and st.backup_wins == 1
+        assert st.coalesced_requests == 2 and st.completed == 3
+
+
+# ------------------------------------------- popularity-aware replication
+class TestPopularityReplication:
+    def test_vector_properties(self):
+        load = np.array([100.0, 10.0, 1.0, 1.0])
+        copies = popularity_replication(load, budget=8, r_min=1,
+                                        r_max=4)
+        assert copies.sum() == 8
+        assert copies.min() >= 1 and copies.max() <= 4
+        assert copies[0] == copies.max()     # the hot shard leads
+        # uniform load degenerates to uniform replication
+        np.testing.assert_array_equal(
+            popularity_replication(np.ones(4), budget=8), [2, 2, 2, 2])
+        # zero load (cold start) also degenerates
+        np.testing.assert_array_equal(
+            popularity_replication(np.zeros(4), budget=8), [2, 2, 2, 2])
+
+    def test_vector_respects_r_max_strands_to_cold(self):
+        copies = popularity_replication(
+            np.array([1000.0, 1.0, 1.0, 1.0]), budget=10, r_min=1,
+            r_max=3)
+        assert copies.sum() == 10
+        assert copies[0] == 3                # clamped
+        assert copies.min() >= 2             # surplus spread to cold
+
+    def test_vector_validation(self):
+        with pytest.raises(ValueError):
+            popularity_replication(np.ones(4), budget=3)   # < P*r_min
+        with pytest.raises(ValueError):
+            popularity_replication(np.ones(4), budget=20, r_max=2)
+
+    def test_load_balanced_uniform_matches_primary_route(self):
+        p = ReplicaPlacement.striped(8, 2)
+        fp = FailoverPlan.load_balanced(p, True, np.ones(8))
+        np.testing.assert_array_equal(
+            fp.route, FailoverPlan.from_health(p, True).route)
+
+    def test_load_balanced_avoids_the_hot_failover_rank(self):
+        """A hot shard fails over onto its standby; from_health then
+        STACKS the standby's own primary shard on the same rank, while
+        the load-weighted route moves that shard to its free standby —
+        strictly more even weighted load, same placement, same route
+        shape/dtype (route VALUES only)."""
+        p = ReplicaPlacement.striped(4, 2, offset=1)  # s -> (s, s+1)
+        alive = np.array([0, 1, 1, 1])       # hot shard 0's rank dead
+        load = np.array([50.0, 1.0, 1.0, 1.0])
+        naive = FailoverPlan.from_health(p, alive)
+        fp = FailoverPlan.load_balanced(p, alive, load)
+        assert fp.fully_covered and naive.fully_covered
+        assert fp.serving_rank(0) == 1       # forced failover
+        assert naive.serving_rank(1) == 1    # first-live stacks rank 1
+        assert fp.serving_rank(1) == 2       # weighted route moves off
+
+        def weighted(plan):
+            w = np.zeros(4)
+            for s in range(4):
+                w[plan.serving_rank(s)] += load[s]
+            return w
+
+        assert weighted(fp).max() < weighted(naive).max()
+        assert fp.route.shape == naive.route.shape
+        assert fp.route.dtype == naive.route.dtype
+
+    def test_route_values_only_zero_retrace(self):
+        """A popularity-driven re-route is VALUES of the same (P,)
+        int32 runtime operand — one compiled program serves
+        from_health and load_balanced routes (the ISSUE 15 audit)."""
+        import jax
+
+        p = ReplicaPlacement.striped(4, 2)
+
+        @jax.jit
+        def consume(x, route):
+            return x + route.sum()
+
+        x = jnp.zeros((2,), jnp.int32)
+        from raft_tpu.resilience import resolve_route
+
+        r1 = resolve_route(FailoverPlan.from_health(p, True), 4, 2, 2)
+        consume(x, jnp.asarray(r1))
+        warmed = consume._cache_size()
+        load = np.array([9.0, 1.0, 1.0, 1.0])
+        r2 = resolve_route(
+            FailoverPlan.load_balanced(p, [1, 0, 1, 1], load), 4, 2, 2)
+        consume(x, jnp.asarray(r2))
+        r3 = resolve_route(
+            FailoverPlan.load_balanced(p, True, load * 7), 4, 2, 2)
+        consume(x, jnp.asarray(r3))
+        assert consume._cache_size() == warmed
+
+    def test_registry_glue_round_trip(self):
+        reg = obsm.MetricRegistry()
+        record_shard_load([4, 0, 2, 0], registry=reg)
+        record_shard_load([1, 1, 0, 0], registry=reg)
+        np.testing.assert_array_equal(
+            measured_shard_load(4, registry=reg), [5, 1, 2, 0])
+        # load_balanced can read straight from the registry
+        p = ReplicaPlacement.striped(4, 2)
+        fp = FailoverPlan.load_balanced(p, True, registry=reg)
+        np.testing.assert_array_equal(
+            fp.route, FailoverPlan.from_health(p, True).route)
+
+
+# ------------------------------------------------- bench row (CI smoke)
+def test_zipf_hot_traffic_row_tiny_config():
+    """The CI-safe zipf_hot_traffic smoke (ISSUE 15 satellite): the
+    bench row runs end-to-end on a tiny CPU config and stamps its
+    acceptance keys — NO QPS assertions (CPU jitter), but the
+    equal-recall spot check and a nonzero hit rate must hold: the Zipf
+    mix guarantees repeats, and repeats must hit."""
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((2048, D)).astype(np.float32)
+    idx = ivf_flat_build(x, IVFFlatParams(n_lists=8, kmeans_n_iters=3,
+                                          seed=2))
+    from bench.bench_serving import zipf_hot_traffic_row
+
+    def make_run(bucket):
+        qcap = idx.warmup(bucket, k=K, n_probes=N_PROBES)
+
+        def run(qq, qcap=qcap):
+            return ivf_flat_search_grouped(
+                idx, qq, K, n_probes=N_PROBES, qcap=qcap,
+            )
+        return run
+
+    row = zipf_hot_traffic_row(
+        make_run, x[:256], k=K, buckets=(4, 8), request_size=2,
+        n_templates=8, n_requests=48, chain=(1, 3), escalate=0,
+        min_duration_s=0.0, max_requests=64,
+    )
+    assert row["scenario"] == "zipf_hot_traffic"
+    if "error" in row:
+        pytest.skip(f"jitter-dominated tiny config: {row['error']}")
+    for key in ("program_qps", "uncached_qps", "cached_qps",
+                "qps_uplift", "cache_hit_rate", "coalesce_rate",
+                "zipf_s", "n_templates", "cached_identical"):
+        assert key in row, key
+    assert row["cache_hit_rate"] > 0.0
+    assert row["cached_identical"] is True

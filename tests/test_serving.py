@@ -378,7 +378,8 @@ def test_serving_latency_rows_tiny_config():
         n=8192, d=8, k=4, n_probes=4, n_lists=8, nqs=(1, 4),
         engines=("ivf_flat",), chain=(1, 3), escalate=0,
         hedged=False, overload=False, mixed=False, open_loop=False,
-    )
+        zipf=False,    # the zipf_hot_traffic row has its own smoke
+    )                  # (tests/test_result_cache.py)
     assert out["unit"] == "ms"
     assert [r["nq"] for r in out["rows"]] == [1, 4]
     for r in out["rows"]:
@@ -1176,3 +1177,85 @@ def test_round13_bench_line_parses_with_obs_overhead():
     for key in ("saturation_qps", "qps_ratio_vs_program"):
         assert key in benchtop._PRINT_KEYS
         assert key not in benchtop._TRIM_ORDER
+
+
+def test_round15_bench_line_parses_with_zipf_hot_traffic():
+    """ISSUE 15 satellite (the _fit_line parse/cap test extended,
+    following the r05-r13 pattern): the round-15 artifact shape — every
+    prior row PLUS the ``zipf_hot_traffic`` row (cache+coalescing
+    saturation vs the uncached path under a Zipf(s≈1.1) mix,
+    docs/serving.md "Hot traffic") — must print as a line that
+    json.loads-round-trips under the 1800-char driver cap, with the
+    acceptance keys (``qps_uplift``, ``cache_hit_rate``,
+    ``cached_qps``, ``p99_ms_cached``) untrimmable."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r15", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01}
+        for i in range(8)
+    ] + [
+        # the round-13 open-loop row, unchanged
+        {"metric": "open_loop_ivf_flat_500000x96", "unit": "QPS",
+         "scenario": "open_loop", "engine": "ivf_flat", "nq": 1024,
+         "program_qps": 1.8e5, "saturation_qps": 1.5e5,
+         "qps_ratio_vs_program": 0.83, "obs_overhead_pct": 1.4,
+         "spread": 0.03, "repeats": 5,
+         "p50_ms_80": 4.2, "p99_ms_80": 14.9, "vs_prev": 1.0},
+        # the round-15 hot-traffic row under test
+        {"metric": "zipf_hot_traffic_ivf_flat_500000x96",
+         "unit": "QPS", "scenario": "zipf_hot_traffic",
+         "engine": "ivf_flat", "nq": 1024, "zipf_s": 1.1,
+         "n_templates": 64, "program_qps": 1.8e5,
+         "uncached_qps": 1.5e5, "cached_qps": 3.4e5,
+         "qps_uplift": 2.27, "cache_hit_rate": 0.61,
+         "coalesce_rate": 0.07, "p99_ms_uncached": 14.9,
+         "p99_ms_cached": 9.1, "cached_identical": True,
+         "spread": 0.03, "repeats": 5, "vs_prev": 1.0},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "program_audit_ms": 34193.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # on a roomy line the row prints whole, acceptance keys included
+    small = benchtop._fit_line({
+        "metric": "zipf_hot_traffic_ivf_flat_500000x96", "unit": "QPS",
+        "cached_qps": 3.4e5, "uncached_qps": 1.5e5,
+        "qps_uplift": 2.27, "cache_hit_rate": 0.61,
+        "coalesce_rate": 0.07, "cached_identical": True,
+        "extras": [],
+    })
+    small_parsed = json.loads(small)
+    assert small_parsed["qps_uplift"] == 2.27
+    assert small_parsed["cache_hit_rate"] == 0.61
+    assert small_parsed["cached_identical"] is True
+    # the acceptance evidence is untrimmable; the secondaries trim
+    for key in ("cached_qps", "qps_uplift", "cache_hit_rate",
+                "p99_ms_cached"):
+        assert key in benchtop._PRINT_KEYS
+        assert key not in benchtop._TRIM_ORDER
+    for key in ("zipf_s", "n_templates", "cached_identical",
+                "coalesce_rate", "p99_ms_uncached", "uncached_qps"):
+        assert key in benchtop._PRINT_KEYS
+        assert key in benchtop._TRIM_ORDER
