@@ -54,6 +54,24 @@ run_programs() {
         --contracts ci/checks/program_contracts.json
 }
 
+run_threads() {
+    echo "== concurrency audit (thread rules + lock-order) =="
+    # the third analysis tier (docs/static_analysis.md "Three tiers"):
+    # hard-gate the lock-discipline rules and drift-check the
+    # acquired-while-held graph against ci/checks/lock_order.json
+    # (cycles always fail). Re-bless intentional ordering changes with:
+    # python -m raft_tpu.analysis --threads --write-lock-order
+    JAX_PLATFORMS=cpu python -m raft_tpu.analysis --threads \
+        --lock-order ci/checks/lock_order.json \
+        raft_tpu tests bench ci bench.py __graft_entry__.py
+    echo "== lockcheck chaos smoke (TracedLock under real interleavings) =="
+    # fail-fast: the executor/compactor chaos paths run with every lock
+    # traced, asserting the pinned order under real thread
+    # interleavings; -x because one violation poisons later asserts
+    RAFT_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_threads.py -q -x
+}
+
 run_install_check() {
     echo "== package import check =="
     # Installability contract: package metadata parses and the distribution
@@ -124,13 +142,14 @@ run_docs() {
 case "$stage" in
     style) run_style ;;
     programs) run_programs ;;
+    threads) run_threads ;;
     test) run_tests ;;
     x64) run_x64 ;;
     docs) run_docs ;;
     multihost) run_multihost_smoke ;;
-    all) run_style; run_programs; run_install_check; run_docs; run_x64; \
-         run_multihost_smoke; run_tests ;;
-    *) echo "unknown stage: $stage (style|programs|test|x64|docs|multihost|all)"
+    all) run_style; run_programs; run_threads; run_install_check; \
+         run_docs; run_x64; run_multihost_smoke; run_tests ;;
+    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|multihost|all)"
        exit 2 ;;
 esac
 echo "CI: OK"

@@ -1,4 +1,4 @@
-"""jaxlint — JAX/TPU-aware static analysis for raft_tpu, in two tiers.
+"""jaxlint — JAX/TPU-aware static analysis for raft_tpu, in three tiers.
 
 **Tier 1 — the AST linter** (:mod:`raft_tpu.analysis.rules`): a
 multi-pass source analyzer purpose-built for this codebase's JAX idioms
@@ -25,12 +25,23 @@ programs, with per-program contracts snapshotted in
 ``ci/checks/program_contracts.json`` and drift-checked by
 ``ci/run.sh programs``.
 
+**Tier 3 — the concurrency auditor** (:mod:`raft_tpu.analysis.threads`):
+a per-class shared-state census feeding four lock-discipline rules
+(``unguarded-shared-state``, ``lock-in-traced-body``,
+``blocking-call-under-lock``, ``sleep-under-lock``), a cross-module
+acquired-while-held lock-order graph with cycle detection and drift
+discipline against ``ci/checks/lock_order.json``, and an injectable
+:class:`~raft_tpu.analysis.threads.runtime.TracedLock` runtime tracer
+(``RAFT_TPU_LOCKCHECK=1``) that asserts the same pinned order under
+real interleavings — gated by ``ci/run.sh threads``.
+
 CLI: ``python -m raft_tpu.analysis [paths] [--format json] [--baseline F]
 [--write-baseline] [--rules a,b] [--list-rules]`` for the source tier;
 ``--programs [--contracts F] [--write-contracts] [--list-programs]`` for
-the program tier. Per-line suppression:
+the program tier; ``--threads [--lock-order F] [--write-lock-order]``
+for the thread tier. Per-line suppression:
 ``# jaxlint: disable=<rule>[,<rule>]``. See docs/static_analysis.md
-("Two tiers: source lint vs program audit").
+("Three tiers").
 """
 
 from raft_tpu.analysis.engine import (

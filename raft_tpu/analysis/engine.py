@@ -262,7 +262,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="re-snapshot the program contracts (pass "
                          "findings still gate the exit code)")
     ap.add_argument("--list-programs", action="store_true")
+    # -- the thread tier (concurrency auditor; docs/static_analysis.md
+    # "Three tiers"). Same lazy-import discipline as --programs: the
+    # census/graph code only loads when asked for.
+    ap.add_argument("--threads", action="store_true",
+                    help="run the concurrency auditor (thread rules + "
+                         "lock-order graph against "
+                         "ci/checks/lock_order.json) instead of the "
+                         "tier-1 rules")
+    ap.add_argument("--lock-order", type=Path, default=None,
+                    help="lock-order JSON (default: "
+                         "ci/checks/lock_order.json)")
+    ap.add_argument("--write-lock-order", action="store_true",
+                    help="re-bless the observed lock-order edges and "
+                         "grandfather current thread findings (cycles "
+                         "still fail)")
     args = ap.parse_args(argv)
+
+    if args.threads:
+        from raft_tpu.analysis.threads.lock_order import main_threads
+
+        return main_threads(args)
+    if args.write_lock_order:
+        print("jaxlint: --write-lock-order requires --threads",
+              file=sys.stderr)
+        return 2
 
     if args.programs or args.list_programs:
         from raft_tpu.analysis.program.contracts import main_programs

@@ -1,0 +1,219 @@
+"""Tier-3 thread rules (the static pass of the concurrency auditor).
+
+Four checks over the per-class census
+(:mod:`raft_tpu.analysis.threads.census`), registered in
+``THREAD_RULES`` (their own registry: ``ci/run.sh threads`` gates them,
+the tier-1 ``style`` stage is unchanged):
+
+* ``unguarded-shared-state`` — an attribute the class demonstrably
+  guards (assigned in ``__init__``, written under an own lock
+  elsewhere) is read or written WITHOUT the lock;
+* ``lock-in-traced-body`` — a lock acquisition inside a jit/shard_map
+  traced body (it would acquire once at trace time and never guard the
+  compiled program);
+* ``blocking-call-under-lock`` — ``Condition.wait`` on a condition
+  whose lock is NOT the one held (or while holding additional locks:
+  ``wait`` parks the thread but releases only its own lock),
+  ``Event.wait``, ``Future.result``, and ``Thread.join`` while holding
+  a lock — each parks a thread that other threads may need the held
+  lock to wake;
+* ``sleep-under-lock`` — ``time.sleep`` while holding a lock
+  serializes every contender behind a timer.
+
+Suppression and baselining follow jaxlint: ``# jaxlint:
+disable=<rule>`` inline, counts grandfathered in the ``findings``
+section of ``ci/checks/lock_order.json``
+(docs/static_analysis.md "Three tiers").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from raft_tpu.analysis.rules import Rule
+from raft_tpu.analysis.threads.census import (
+    ClassCensus,
+    get_census,
+    _self_attr,
+)
+
+__all__ = ["THREAD_RULES"]
+
+
+def _censuses(ctx) -> List[ClassCensus]:
+    mc = get_census(ctx)
+    return list(mc.classes.values()) + [mc.toplevel]
+
+
+class UnguardedSharedState(Rule):
+    name = "unguarded-shared-state"
+    description = (
+        "an attribute the class guards with a lock elsewhere is "
+        "read/written without holding it"
+    )
+
+    def check(self, ctx) -> Iterator:
+        for census in _censuses(ctx):
+            if not census.guarded:
+                continue
+            for method, node, attr, kind in census.accesses:
+                if attr not in census.guarded:
+                    continue
+                if census.own_locks_held(node):
+                    continue
+                lock = next(iter(census.locks.values()), "_lock")
+                yield ctx.finding(
+                    self.name, node,
+                    f"{census.name}.{attr} is guarded (written under "
+                    f"self.{lock} elsewhere) but {kind} in "
+                    f"{method}() without the lock",
+                )
+
+
+class LockInTracedBody(Rule):
+    name = "lock-in-traced-body"
+    description = (
+        "lock acquired inside a jit/shard_map traced body (locks once "
+        "at trace time, guards nothing at run time)"
+    )
+
+    def check(self, ctx) -> Iterator:
+        traced: Set[ast.AST] = set()
+        for fn in ctx.facts.traced:
+            traced.update(ctx.facts.traced_body_nodes(fn))
+        if not traced:
+            return
+        for census in _censuses(ctx):
+            for _method, with_node, key in census.acquisitions:
+                if with_node in traced:
+                    yield ctx.finding(
+                        self.name, with_node,
+                        f"lock {key.split(':', 1)[1]} acquired inside "
+                        "a traced body",
+                    )
+            for node in census.method_of:
+                if node not in traced or not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire" \
+                        and census.lock_key(f.value) is not None:
+                    yield ctx.finding(
+                        self.name, node,
+                        "lock .acquire() inside a traced body",
+                    )
+
+
+class BlockingCallUnderLock(Rule):
+    name = "blocking-call-under-lock"
+    description = (
+        "Condition.wait on a foreign lock, Event.wait, Future.result, "
+        "or Thread.join while holding a lock"
+    )
+
+    def check(self, ctx) -> Iterator:
+        for census in _censuses(ctx):
+            aliases = self._thread_aliases(census)
+            for node, method in census.method_of.items():
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                held = census.effective_held(node)
+                if not held:
+                    continue
+                tail = f.attr
+                recv_attr = _self_attr(f.value)
+                if tail in ("wait", "wait_for"):
+                    yield from self._check_wait(
+                        ctx, census, node, method, recv_attr, held)
+                elif tail == "result":
+                    yield ctx.finding(
+                        self.name, node,
+                        f"Future.result() while holding "
+                        f"{self._chain(held)} in {method}()",
+                    )
+                elif tail == "join":
+                    is_thread = recv_attr in census.thread_attrs
+                    if not is_thread and isinstance(f.value, ast.Name):
+                        is_thread = f.value.id in aliases.get(method,
+                                                              set())
+                    if is_thread:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"Thread.join() while holding "
+                            f"{self._chain(held)} in {method}()",
+                        )
+
+    def _check_wait(self, ctx, census, node, method, recv_attr, held):
+        if recv_attr in census.event_attrs:
+            yield ctx.finding(
+                self.name, node,
+                f"Event.wait() while holding {self._chain(held)} "
+                f"in {method}()",
+            )
+            return
+        if recv_attr not in census.locks:
+            return   # unknown receiver: lexical limits
+        underlying = f"self:{census.locks[recv_attr]}"
+        others = [k for k in held if k != underlying]
+        if underlying not in held:
+            yield ctx.finding(
+                self.name, node,
+                f"Condition self.{recv_attr}.wait() without holding "
+                f"its own lock in {method}()",
+            )
+        elif others:
+            yield ctx.finding(
+                self.name, node,
+                f"Condition self.{recv_attr}.wait() releases only its "
+                f"own lock; {self._chain(tuple(others))} stays held "
+                f"while parked in {method}()",
+            )
+
+    @staticmethod
+    def _chain(held) -> str:
+        return " -> ".join(k.split(":", 1)[1] for k in held)
+
+    @staticmethod
+    def _thread_aliases(census) -> Dict[str, Set[str]]:
+        """Per method: local names assigned from a thread attr
+        (``t = self._thread``)."""
+        out: Dict[str, Set[str]] = {}
+        for node, method in census.method_of.items():
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _self_attr(node.value) in census.thread_attrs:
+                out.setdefault(method, set()).add(node.targets[0].id)
+        return out
+
+
+class SleepUnderLock(Rule):
+    name = "sleep-under-lock"
+    description = "time.sleep while holding a lock"
+
+    def check(self, ctx) -> Iterator:
+        for census in _censuses(ctx):
+            for node, method in census.method_of.items():
+                if not isinstance(node, ast.Call):
+                    continue
+                if ctx.facts.callee(node) != "time.sleep":
+                    continue
+                held = census.effective_held(node)
+                if held:
+                    chain = BlockingCallUnderLock._chain(held)
+                    yield ctx.finding(
+                        self.name, node,
+                        f"time.sleep() while holding {chain} in "
+                        f"{method}()",
+                    )
+
+
+THREAD_RULES: List[Rule] = [
+    UnguardedSharedState(),
+    LockInTracedBody(),
+    BlockingCallUnderLock(),
+    SleepUnderLock(),
+]

@@ -11,6 +11,14 @@ class wraps them with an imperative facade like the reference's
 associativity dimension — no host branching — and each operation runs as
 ONE jitted program (the result cache calls these per serving submit, where
 an eager ~10-op dispatch chain per lookup was the whole cache cost).
+
+Thread safety: this class holds NO lock on purpose. State is functional
+(every mutation returns out-of-place arrays rebound to the fields), so
+concurrent callers must serialize externally — the result cache does it
+under ``ResultCache._lock``, which is exactly how the concurrency
+auditor's census sees it (docs/static_analysis.md "Three tiers": the
+lock-order graph tracks ``ResultCache._lock``; an unlocked VectorCache
+shared across threads would lose updates, not corrupt memory).
 """
 
 from __future__ import annotations

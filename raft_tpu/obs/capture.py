@@ -30,6 +30,7 @@ import time
 from typing import Callable, List, Optional
 
 from raft_tpu import errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.core.annotate import start_trace, stop_trace
 from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs.flight import FlightRecorder
@@ -103,7 +104,7 @@ class ProfileTrigger:
         self._stop_trace = stop
         self._sleep = sleep
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("ProfileTrigger._lock")
         self._prev_counts = histogram.counts_snapshot()
         self._breaches = 0
         self._captures = 0
@@ -198,6 +199,9 @@ class ProfileTrigger:
         errors.expects(interval_s > 0,
                        "ProfileTrigger.watch: interval_s=%s <= 0",
                        interval_s)
+        from raft_tpu.obs import crash as _crash
+
+        _crash.install_excepthook()
         with self._lock:
             if self._watch_thread is not None:
                 return self

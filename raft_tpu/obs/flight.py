@@ -38,12 +38,12 @@ attribute load per hop.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from raft_tpu import errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.obs import metrics as _metrics
 
 __all__ = ["FlightRecorder"]
@@ -70,7 +70,7 @@ class FlightRecorder:
         self.dump_dir = dump_dir
         self.name = name
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("FlightRecorder._lock")
         self._ring: deque = deque(maxlen=self.capacity)
         self._dropped = 0
         self._dump_seq = 0
@@ -158,5 +158,7 @@ class FlightRecorder:
     def __repr__(self) -> str:
         with self._lock:
             n = len(self._ring)
+        # repr races are benign: len() of a grow-only list
+        nd = len(self.dumps_written)  # jaxlint: disable=unguarded-shared-state
         return (f"FlightRecorder(name={self.name!r}, events={n}/"
-                f"{self.capacity}, dumps={len(self.dumps_written)})")
+                f"{self.capacity}, dumps={nd})")

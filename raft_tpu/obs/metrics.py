@@ -110,6 +110,11 @@ class _Instrument:
     def __init__(self, name: str, labels: Mapping[str, str]):
         self.name = name
         self.labels = dict(labels)
+        # deliberately a PLAIN lock, never lockcheck.make_lock: the
+        # instrument lock is the terminal leaf of the lock-order graph
+        # (everything may feed metrics while holding its own lock), and
+        # the TracedLock release path itself observes lock_hold_ms —
+        # tracing this lock would recurse through that feed
         self._lock = threading.Lock()
 
     def label_str(self) -> str:
@@ -366,6 +371,10 @@ class MetricRegistry:
     """
 
     def __init__(self, *, clock: Callable[[], float] = time.time):
+        # plain on purpose, like _Instrument._lock: the registry is a
+        # near-leaf of the lock-order graph (it only takes instrument
+        # locks), and the runtime tracer lazily creates its own
+        # histogram handles through this lock
         self._lock = threading.Lock()
         self._series: Dict[Tuple[str, frozenset], _Instrument] = {}
         # name -> kind, across ALL label sets: the one-name-one-type
@@ -499,6 +508,9 @@ class MetricRegistry:
         time series the soak/bench runs graph. Call ``stop()`` (or let
         the process exit; the thread is a daemon and every line is
         written with flush)."""
+        from raft_tpu.obs import crash as _crash  # circular-safe here
+
+        _crash.install_excepthook()
         em = JsonlEmitter(self, path, interval_s=interval_s)
         with self._lock:
             self._emitters.append(em)

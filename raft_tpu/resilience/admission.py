@@ -71,11 +71,11 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import math
-import threading
 import time
 from typing import Callable, Optional
 
 from raft_tpu import errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.resilience.deadline import Deadline
 
@@ -164,8 +164,8 @@ class AdmissionController:
         )
         self.retry_after_s = retry_after_s
         self._clock = clock
-        self._lock = threading.Lock()
-        self._slot_free = threading.Condition(self._lock)
+        self._lock = lockcheck.make_lock("AdmissionController._lock")
+        self._slot_free = lockcheck.make_condition(self._lock)
         self._in_flight = 0
         self._queue_depth = 0
         self._peak_queue = 0

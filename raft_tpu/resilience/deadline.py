@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import threading
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
@@ -38,6 +37,7 @@ import jax
 import numpy as np
 
 from raft_tpu import errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.core.interruptible import Interruptible
 
 __all__ = [
@@ -253,7 +253,7 @@ class HedgePolicy:
         self.max_delay_s = float(max_delay_s)
         self.window = int(window)
         self.min_samples = int(min_samples)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("HedgePolicy._lock")
         self._samples: List[float] = []
         self.hedges = 0
         self.unhedged = 0

@@ -22,13 +22,13 @@ health never recompiles the serving program.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from raft_tpu import errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.obs import metrics as obs_metrics
 
 __all__ = ["ShardHealth", "HealthProbe", "HealthReport", "health_check"]
@@ -57,7 +57,7 @@ class ShardHealth:
 
     def __init__(self, n_ranks: int, *, telemetry: bool = True):
         errors.expects(n_ranks >= 1, "ShardHealth: n_ranks=%d < 1", n_ranks)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("ShardHealth._lock")
         self._up = np.ones(n_ranks, dtype=bool)
         # `telemetry=False` is for THROWAWAY trackers (the
         # resolve_shard_mask HealthReport normalization builds one per
@@ -74,13 +74,15 @@ class ShardHealth:
 
     @property
     def n_ranks(self) -> int:
-        return self._up.shape[0]
+        # .shape is immutable metadata of an array that is only ever
+        # mutated in place, never rebound — safe to read unlocked
+        return self._up.shape[0]  # jaxlint: disable=unguarded-shared-state
 
     def _check_rank(self, rank: int) -> None:
-        errors.expects(
-            0 <= rank < self._up.shape[0],
+        errors.expects(   # .shape reads: immutable metadata, see n_ranks
+            0 <= rank < self._up.shape[0],  # jaxlint: disable=unguarded-shared-state
             "ShardHealth: rank %d out of range [0, %d)",
-            rank, self._up.shape[0],
+            rank, self._up.shape[0],  # jaxlint: disable=unguarded-shared-state
         )
 
     def mark_down(self, rank: int) -> None:

@@ -57,7 +57,9 @@ import jax
 import numpy as np
 
 from raft_tpu import compat, errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.core.interruptible import Interruptible
+from raft_tpu.obs import crash as obs_crash
 from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.obs.flight import FlightRecorder
 from raft_tpu.resilience.admission import AdmissionController
@@ -320,9 +322,9 @@ class ServingExecutor:
         # docs/serving.md "Hot traffic" for the install ordering rule
         self._rt_epoch = int(self._epoch_fn())
 
-        self._lock = threading.Lock()
-        self._work = threading.Condition(self._lock)       # batcher wake
-        self._done = threading.Condition(self._lock)       # drain wake
+        self._lock = lockcheck.make_lock("ServingExecutor._lock")
+        self._work = lockcheck.make_condition(self._lock)  # batcher wake
+        self._done = lockcheck.make_condition(self._lock)  # drain wake
         self._pending: List[PendingRequest] = []
         self._inflight: List[_InFlight] = []
         self._closed = False
@@ -340,6 +342,12 @@ class ServingExecutor:
         self._backup_wins = 0
         self._runtime: Dict[str, Any] = dict(runtime_inputs or {})
 
+        # a dead batcher/drainer must not vanish silently: route
+        # uncaught thread exceptions to thread_uncaught_total + a
+        # flight event (docs/observability.md "Thread crashes")
+        obs_crash.install_excepthook()
+        if self.flight is not None:
+            obs_crash.set_flight_sink(self.flight)
         self._batcher = threading.Thread(
             target=self._batch_loop, name=f"{name}-batcher", daemon=True,
         )
@@ -697,6 +705,7 @@ class ServingExecutor:
             t_s0 = self._clock()
             staged = self._stage(batch.queries)
             t0 = self._clock()
+            lockcheck.note_dispatch("ServingExecutor._dispatch")
             out = self._dispatch(staged, **runtime)
             # staging is the host-side cost of the device_put call —
             # the transfer itself overlaps compute (that's the point);

@@ -52,12 +52,12 @@ from __future__ import annotations
 import collections
 import dataclasses
 import hashlib
-import threading
 from typing import Callable, Optional, Tuple
 
 import numpy as np
 
 from raft_tpu import errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.cache import VectorCache
 from raft_tpu.obs import metrics as obs_metrics
 
@@ -247,7 +247,7 @@ class ResultCache:
         self.semantic_enabled = False
         self.measured_semantic_recall: Optional[float] = None
         self._salt = b"k%d" % self.k
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("ResultCache._lock")
         self._exact = VectorCache(self.dim, n_sets=n_sets,
                                   associativity=associativity,
                                   dtype=np.int32)

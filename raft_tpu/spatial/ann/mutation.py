@@ -52,6 +52,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import compat, errors
+from raft_tpu.analysis.threads import runtime as lockcheck
+from raft_tpu.obs import crash as obs_crash
 from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit, kmeans_predict
 from raft_tpu.spatial.ann.common import (
@@ -104,7 +106,7 @@ __all__ = [
 # and handles are cached per name so the ack path pays one dict get.
 # RAFT_TPU_OBS=off no-ops them all.
 _mseries_cache: dict = {}
-_mseries_lock = threading.Lock()
+_mseries_lock = lockcheck.make_lock("mutation._mseries_lock")
 
 
 def _mseries(index_name: str) -> dict:
@@ -949,7 +951,7 @@ class BackgroundCompactor:
                  **compact_kw):
         self.policy = policy
         self._kw = compact_kw
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("BackgroundCompactor._lock")
         self._thread: typing.Optional[threading.Thread] = None
         self._result = None
         self._error: typing.Optional[BaseException] = None
@@ -983,7 +985,9 @@ class BackgroundCompactor:
                     self._result = res
                     self._n_compactions += 1
 
-            self._thread = threading.Thread(target=work, daemon=True)
+            obs_crash.install_excepthook()
+            self._thread = threading.Thread(
+                target=work, daemon=True, name="ann-compactor")
             self._thread.start()
             return True
 
@@ -1008,9 +1012,32 @@ class BackgroundCompactor:
             return res
 
     def join(self, timeout: typing.Optional[float] = None) -> None:
-        t = self._thread
+        with self._lock:
+            t = self._thread
         if t is not None:
             t.join(timeout)
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Join the in-flight compaction (bounded) and RE-RAISE a
+        stored worker exception instead of dropping it — the shutdown
+        analog of :meth:`poll`: a compaction that crashed after the
+        caller stopped polling must not vanish with the process.
+        Raises ``TimeoutError`` if the worker outlives ``timeout_s``
+        (the thread ref is read under the lock; the join itself blocks
+        without it)."""
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"BackgroundCompactor: worker still running after "
+                    f"{timeout_s:.1f}s")
+        with self._lock:
+            self._thread = None
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
 
 
 # ------------------------------------------- incremental checkpoint (v4)

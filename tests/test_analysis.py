@@ -1527,6 +1527,26 @@ def test_rule_docs_and_cli_parity():
         )
         assert p.description
     assert "### `program-contract`" in docs  # the drift rule too
+    # the thread tier's rules (ISSUE 16) are held to the same bar:
+    # docs heading + their own --threads --list-rules output
+    from raft_tpu.analysis.threads.rules import THREAD_RULES
+
+    proc3 = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--threads",
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc3.returncode == 0
+    for r in THREAD_RULES:
+        assert f"### `{r.name}`" in docs, (
+            f"thread rule {r.name} has no '### `{r.name}`' heading in "
+            "docs/static_analysis.md"
+        )
+        assert r.description
+        assert f"{r.name}:" in proc3.stdout, r.name
+    for graph_rule in ("lock-order-drift", "lock-order-cycle"):
+        assert f"### `{graph_rule}`" in docs
+        assert f"{graph_rule}:" in proc3.stdout
 
 
 def test_repo_lints_clean():

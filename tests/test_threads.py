@@ -1,0 +1,786 @@
+"""The concurrency auditor (ISSUE 16): tier-3 static rules over seeded
+positive/negative fixtures, the cross-module lock-order graph
+(edges, cycles, drift vs ``ci/checks/lock_order.json``), the
+``TracedLock`` runtime tracer, ``threading.excepthook`` crash routing,
+``BackgroundCompactor.stop()`` crash propagation, and the executor
+close-vs-submit race under ``RAFT_TPU_LOCKCHECK=1`` — ended with the
+repo-wide self-gate (``ci/run.sh threads`` runs this file with every
+lock traced, so the pinned order is asserted under real
+interleavings)."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.analysis.engine import lint_source
+from raft_tpu.analysis.threads import runtime as lockcheck
+from raft_tpu.analysis.threads.lock_order import (
+    build_graph,
+    drift_findings,
+    load_order_file,
+)
+from raft_tpu.analysis.threads.rules import THREAD_RULES
+from raft_tpu.obs import crash as obs_crash
+from raft_tpu.obs import metrics as obsm
+from raft_tpu.obs.flight import FlightRecorder
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def names(findings):
+    return [f.rule for f in findings]
+
+
+def tlint(src):
+    return lint_source(src, rules=THREAD_RULES)
+
+
+@pytest.fixture()
+def lockcheck_on():
+    """Tracing on with a clean slate; restores the prior gate and
+    pinned order afterward (the env-driven CI run keeps its state)."""
+    prev = lockcheck.set_enabled(True)
+    prev_order = lockcheck.pinned_order()
+    lockcheck.clear()
+    yield
+    lockcheck.clear()
+    lockcheck.pin_order(prev_order)
+    lockcheck.set_enabled(prev)
+
+
+# ------------------------------------------------ static: shared state
+class TestUnguardedSharedState:
+    def test_unlocked_read_of_guarded_attr_flagged(self):
+        src = """import threading
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+    def peek(self):
+        return self._items
+"""
+        fs = tlint(src)
+        assert names(fs) == ["unguarded-shared-state"]
+        assert "_items" in fs[0].message and "peek" in fs[0].message
+
+    def test_all_access_under_lock_clean(self):
+        src = """import threading
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+    def peek(self):
+        with self._lock:
+            return list(self._items)
+"""
+        assert tlint(src) == []
+
+    def test_init_only_attrs_not_guarded(self):
+        """Immutable config read everywhere must not be census'd: the
+        write-under-lock requirement is what keeps `self.dim` out."""
+        src = """import threading
+class Box:
+    def __init__(self, dim):
+        self._lock = threading.Lock()
+        self.dim = dim
+        self._n = 0
+    def bump(self):
+        with self._lock:
+            self._n += 1
+    def shape(self):
+        return self.dim
+"""
+        assert tlint(src) == []
+
+    def test_condition_canonicalizes_to_underlying_lock(self):
+        """`with self._work:` IS `with self._lock:` for the census —
+        the executor's two-conditions-one-lock idiom."""
+        src = """import threading
+class Ex:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._pending = []
+    def put(self, x):
+        with self._work:
+            self._pending.append(x)
+    def flush(self):
+        with self._lock:
+            out, self._pending = self._pending, []
+        return out
+"""
+        assert tlint(src) == []
+
+    def test_nested_def_resets_held_stack(self):
+        """A thread-target closure runs on ANOTHER thread: the lexical
+        lock around `Thread(target=work)` does not guard the body."""
+        src = """import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._result = None
+    def submit(self):
+        with self._lock:
+            def work():
+                self._result = 1
+            t = threading.Thread(target=work)
+            t.start()
+    def poll(self):
+        with self._lock:
+            self._result = None
+"""
+        fs = tlint(src)
+        assert names(fs) == ["unguarded-shared-state"]
+
+    def test_private_helper_inference(self):
+        """A private method whose intra-class call sites ALL hold the
+        lock executes under it — the documented 'under _lock' helper
+        idiom (`_flush_wait_s`)."""
+        src = """import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+    def _oldest(self):
+        return self._pending[0]
+    def tick(self):
+        with self._lock:
+            self._pending.append(1)
+            return self._oldest()
+"""
+        assert tlint(src) == []
+
+    def test_suppression(self):
+        src = """import threading
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+    def peek(self):
+        return len(self._items)  # jaxlint: disable=unguarded-shared-state
+"""
+        assert tlint(src) == []
+
+
+# ------------------------------------------------ static: traced bodies
+class TestLockInTracedBody:
+    def test_module_lock_in_jitted_body_flagged(self):
+        src = """import threading
+import jax
+_glock = threading.Lock()
+@jax.jit
+def f(x):
+    with _glock:
+        return x + 1
+"""
+        fs = tlint(src)
+        assert "lock-in-traced-body" in names(fs)
+
+    def test_lock_outside_traced_body_clean(self):
+        src = """import threading
+import jax
+_glock = threading.Lock()
+@jax.jit
+def f(x):
+    return x + 1
+def g(x):
+    with _glock:
+        return f(x)
+"""
+        assert tlint(src) == []
+
+
+# ------------------------------------------------ static: blocking calls
+class TestBlockingCallUnderLock:
+    def test_condition_wait_on_own_lock_clean(self):
+        src = """import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._n = 0
+    def bump(self):
+        with self._cv:
+            self._n += 1
+    def park(self):
+        with self._cv:
+            while not self._n:
+                self._cv.wait(0.1)
+"""
+        assert tlint(src) == []
+
+    def test_event_wait_under_lock_flagged(self):
+        src = """import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._n = 0
+    def bump(self):
+        with self._lock:
+            self._n += 1
+    def park(self):
+        with self._lock:
+            self._stop.wait(1.0)
+"""
+        assert "blocking-call-under-lock" in names(tlint(src))
+
+    def test_future_result_under_lock_flagged(self):
+        src = """import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def get(self, fut):
+        with self._lock:
+            return fut.result(1.0)
+"""
+        assert names(tlint(src)) == ["blocking-call-under-lock"]
+
+    def test_thread_join_under_lock_flagged_incl_alias(self):
+        src = """import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=print)
+    def bad_direct(self):
+        with self._lock:
+            self._t.join()
+    def bad_alias(self):
+        with self._lock:
+            t = self._t
+            t.join()
+    def fine(self):
+        with self._lock:
+            t = self._t
+        t.join()
+"""
+        fs = tlint(src)
+        assert names(fs) == ["blocking-call-under-lock"] * 2
+
+    def test_str_join_never_trips(self):
+        src = """import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def fmt(self, parts):
+        with self._lock:
+            return ",".join(parts)
+"""
+        assert tlint(src) == []
+
+    def test_wait_with_extra_lock_held_flagged(self):
+        """`wait` releases only its OWN lock; an outer lock stays held
+        while the thread parks."""
+        src = """import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+    def park(self):
+        with self._a:
+            with self._cv:
+                self._cv.wait(0.1)
+"""
+        fs = tlint(src)
+        assert names(fs) == ["blocking-call-under-lock"]
+        assert "stays held" in fs[0].message
+
+
+# ------------------------------------------------ static: sleep
+class TestSleepUnderLock:
+    def test_sleep_under_lock_flagged(self):
+        src = """import threading
+import time
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def tick(self):
+        with self._lock:
+            time.sleep(0.01)
+"""
+        assert names(tlint(src)) == ["sleep-under-lock"]
+
+    def test_sleep_outside_lock_clean(self):
+        src = """import threading
+import time
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+    def tick(self):
+        with self._lock:
+            self._n += 1
+        time.sleep(0.01)
+"""
+        assert tlint(src) == []
+
+
+# ------------------------------------------------ the lock-order graph
+GRAPH_A = """import threading
+class Outer:
+    def __init__(self, inner: "Inner"):
+        self._lock = threading.Lock()
+        self.inner = inner
+        self._n = 0
+    def tick(self):
+        with self._lock:
+            self._n += 1
+            self.inner.bump()
+"""
+GRAPH_B = """import threading
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+    def bump(self):
+        with self._lock:
+            self._n += 1
+"""
+
+
+class TestLockOrderGraph:
+    def _write(self, tmp_path, **files):
+        for name, src in files.items():
+            (tmp_path / f"{name}.py").write_text(src)
+        return tmp_path
+
+    def test_cross_object_edge_via_annotation(self, tmp_path):
+        self._write(tmp_path, outer=GRAPH_A, inner=GRAPH_B)
+        g = build_graph([tmp_path], root=tmp_path)
+        assert ("Outer._lock", "Inner._lock") in g.edge_list()
+        assert g.cycles() == []
+
+    def test_nested_with_and_module_lock_edges(self, tmp_path):
+        src = """import threading
+_mlock = threading.Lock()
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+    def tick(self):
+        with self._lock:
+            self._n += 1
+            with _mlock:
+                pass
+"""
+        self._write(tmp_path, mod=src)
+        g = build_graph([tmp_path], root=tmp_path)
+        assert ("C._lock", "mod._mlock") in g.edge_list()
+
+    def test_cycle_detected(self, tmp_path):
+        src = """import threading
+class A:
+    def __init__(self, b: "B"):
+        self._lock = threading.Lock()
+        self.b = b
+        self._n = 0
+    def fwd(self):
+        with self._lock:
+            self._n += 1
+            self.b.bump()
+    def bump(self):
+        with self._lock:
+            self._n += 1
+class B:
+    def __init__(self, a: "A"):
+        self._lock = threading.Lock()
+        self.a = a
+        self._n = 0
+    def bump(self):
+        with self._lock:
+            self._n += 1
+    def back(self):
+        with self._lock:
+            self._n += 1
+            self.a.bump()
+"""
+        self._write(tmp_path, cyc=src)
+        g = build_graph([tmp_path], root=tmp_path)
+        cycles = g.cycles()
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"A._lock", "B._lock"}
+
+    def test_drift_new_and_stale_edges(self, tmp_path):
+        self._write(tmp_path, outer=GRAPH_A, inner=GRAPH_B)
+        g = build_graph([tmp_path], root=tmp_path)
+        op = tmp_path / "lock_order.json"
+        # empty blessed order: the observed edge is NEW
+        fs = drift_findings(g, {}, op)
+        assert [f.rule for f in fs] == ["lock-order-drift"]
+        assert "new acquired-while-held edge" in fs[0].message
+        # blessed exactly: clean
+        assert drift_findings(g, {"Outer._lock": ["Inner._lock"]}, op) == []
+        # transitively implied: clean (matches the runtime tracer)
+        order = {"Outer._lock": ["Mid._lock"], "Mid._lock": ["Inner._lock"]}
+        assert not any("new" in f.message
+                       for f in drift_findings(g, order, op))
+        # a blessed edge with no observed path is STALE
+        fs = drift_findings(g, {"Outer._lock": ["Inner._lock"],
+                                "Ghost._lock": ["Inner._lock"]}, op)
+        assert len(fs) == 1 and "stale blessed edge" in fs[0].message
+
+    def test_cli_write_then_clean_then_drift(self, tmp_path):
+        self._write(tmp_path, outer=GRAPH_A, inner=GRAPH_B)
+        op = tmp_path / "lock_order.json"
+
+        def run(*extra):
+            return subprocess.run(
+                [sys.executable, "-m", "raft_tpu.analysis", "--threads",
+                 "--lock-order", str(op), str(tmp_path), *extra],
+                capture_output=True, text=True, cwd=REPO,
+            )
+
+        # unblessed edge fails; --write-lock-order pins it; clean after
+        assert run().returncode == 1
+        w = run("--write-lock-order")
+        assert w.returncode == 0, w.stdout + w.stderr
+        data = json.loads(op.read_text())
+        assert data["order"] == {"Outer._lock": ["Inner._lock"]}
+        assert run().returncode == 0
+        # a new nested acquisition drifts red again
+        (tmp_path / "extra.py").write_text("""import threading
+_zlock = threading.Lock()
+class Z:
+    def __init__(self, inner: "Inner"):
+        self._lock = threading.Lock()
+        self.inner = inner
+        self._n = 0
+    def tick(self):
+        with self._lock:
+            self._n += 1
+            self.inner.bump()
+""")
+        p = run()
+        assert p.returncode == 1 and "Z._lock -> Inner._lock" in p.stdout
+
+    def test_cli_refuses_to_bless_cycles(self, tmp_path):
+        (tmp_path / "cyc.py").write_text("""import threading
+_a = threading.Lock()
+_b = threading.Lock()
+def fwd():
+    with _a:
+        with _b:
+            pass
+def back():
+    with _b:
+        with _a:
+            pass
+""")
+        op = tmp_path / "lock_order.json"
+        p = subprocess.run(
+            [sys.executable, "-m", "raft_tpu.analysis", "--threads",
+             "--lock-order", str(op), str(tmp_path),
+             "--write-lock-order"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert p.returncode == 1
+        assert "refusing to bless a cyclic order" in p.stderr
+        assert not op.exists()
+
+    def test_list_rules(self):
+        p = subprocess.run(
+            [sys.executable, "-m", "raft_tpu.analysis", "--threads",
+             "--list-rules"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert p.returncode == 0
+        for r in THREAD_RULES:
+            assert f"{r.name}:" in p.stdout
+        assert "lock-order-drift:" in p.stdout
+        assert "lock-order-cycle:" in p.stdout
+
+
+# ------------------------------------------------ the runtime tracer
+class TestTracedLockRuntime:
+    def test_blessed_direct_and_transitive_clean(self, lockcheck_on):
+        lockcheck.pin_order({"A": ["B"], "B": ["C"]})
+        A, B, C = (lockcheck.make_lock(n) for n in "ABC")
+        with A:
+            with B:
+                pass
+        with A:
+            with C:            # A -> B -> C transitively blessed
+                pass
+        lockcheck.assert_clean()
+        assert "A" in lockcheck.observed_edges()
+
+    def test_inversion_and_unpinned_recorded(self, lockcheck_on):
+        lockcheck.pin_order({"A": ["B"]})
+        A, B, D = (lockcheck.make_lock(n) for n in "ABD")
+        with B:
+            with A:            # reverse of the blessed path
+                pass
+        with A:
+            with D:            # edge the graph has never seen
+                pass
+        kinds = [v.kind for v in lockcheck.violations()]
+        assert kinds == ["inversion", "unpinned"]
+        with pytest.raises(AssertionError, match="inversion"):
+            lockcheck.assert_clean()
+
+    def test_self_reacquire_raises(self, lockcheck_on):
+        lockcheck.pin_order({})
+        A = lockcheck.make_lock("A")
+        with pytest.raises(RuntimeError, match="re-acquiring"):
+            with A:
+                with A:
+                    pass
+        assert lockcheck.held_locks() == ()   # stack unwound cleanly
+
+    def test_try_acquire_skips_order_check(self, lockcheck_on):
+        lockcheck.pin_order({"A": ["B"]})
+        A, B = lockcheck.make_lock("A"), lockcheck.make_lock("B")
+        with B:
+            assert A.acquire(blocking=False)   # try-lock cannot deadlock
+            A.release()
+        lockcheck.assert_clean()
+
+    def test_condition_wait_keeps_stack_truthful(self, lockcheck_on):
+        lockcheck.pin_order({})
+        L = lockcheck.make_lock("CvLock")
+        cv = lockcheck.make_condition(L)
+        state = []
+
+        def waiter():
+            with cv:
+                while not state:
+                    cv.wait(0.5)
+                state.append(lockcheck.held_locks())
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            state.append("go")
+            cv.notify()
+        t.join(2)
+        assert not t.is_alive()
+        assert state[-1] == ("CvLock",)   # re-acquired after wait
+        lockcheck.assert_clean()
+
+    def test_hold_outlier_and_histogram_feed(self, lockcheck_on,
+                                             monkeypatch):
+        import raft_tpu.analysis.threads.runtime as rt
+
+        monkeypatch.setattr(rt, "HOLD_OUTLIER_MS", 5.0)
+        lockcheck.pin_order({})
+        prev_obs = obsm.set_enabled(True)
+        try:
+            A = lockcheck.make_lock("OutlierLock")
+            with A:
+                time.sleep(0.02)
+            outs = lockcheck.hold_outliers()
+            assert any(o.lock == "OutlierLock" and o.held_ms >= 5.0
+                       for o in outs)
+            snap = obsm.default_registry().snapshot()
+            assert any(row["labels"].get("lock") == "OutlierLock"
+                       for row in snap["lock_hold_ms"])
+        finally:
+            obsm.set_enabled(prev_obs)
+
+    def test_violation_counter_feed(self, lockcheck_on):
+        lockcheck.pin_order({"A": ["B"]})
+        prev_obs = obsm.set_enabled(True)
+        try:
+            A, B = lockcheck.make_lock("A"), lockcheck.make_lock("B")
+            with B:
+                with A:
+                    pass
+            snap = obsm.default_registry().snapshot()
+            assert any(
+                row["labels"] == {"kind": "inversion"}
+                for row in snap["lock_order_violations_total"]
+            )
+        finally:
+            obsm.set_enabled(prev_obs)
+
+    def test_note_dispatch(self, lockcheck_on):
+        lockcheck.pin_order({})
+        lockcheck.note_dispatch("x")          # nothing held: no-op
+        lockcheck.assert_clean()
+        A = lockcheck.make_lock("A")
+        with A:
+            lockcheck.note_dispatch("dev")
+        vs = lockcheck.violations()
+        assert [v.kind for v in vs] == ["hold-while-dispatch"]
+        assert vs[0].acquiring == "dev"
+
+    def test_disabled_hands_back_plain_lock(self):
+        prev = lockcheck.set_enabled(False)
+        try:
+            L = lockcheck.make_lock("P")
+            assert not isinstance(L, lockcheck.TracedLock)
+        finally:
+            lockcheck.set_enabled(prev)
+
+    def test_pinned_order_loads_from_repo_file(self):
+        order, baseline = load_order_file(
+            REPO / "ci" / "checks" / "lock_order.json")
+        assert "ServingExecutor._lock" in order
+        assert lockcheck.load_pinned_order(
+            REPO / "ci" / "checks" / "lock_order.json")
+
+
+# ------------------------------------------------ excepthook (sat. 1)
+class TestThreadCrashRouting:
+    # the injected crash IS the point — pytest's threadexception
+    # plugin would flag it as an unhandled thread exception
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_uncaught_exception_counts_and_flight_event(self):
+        obs_crash.install_excepthook()
+        obs_crash.install_excepthook()   # idempotent
+        fr = FlightRecorder(capacity=16, name="crash-test")
+        prev_obs = obsm.set_enabled(True)
+        obs_crash.set_flight_sink(fr)
+        try:
+            def boom():
+                raise ValueError("injected crash")
+
+            t = threading.Thread(target=boom, name="crashy-worker",
+                                 daemon=True)
+            t.start()
+            t.join(5)
+            snap = obsm.default_registry().snapshot()
+            assert any(
+                row["labels"].get("thread") == "crashy-worker"
+                for row in snap.get("thread_uncaught_total", [])
+            )
+            evs = [e for e in fr.events()
+                   if e["event"] == "thread_uncaught"]
+            assert evs and evs[-1]["thread"] == "crashy-worker"
+            assert evs[-1]["exc_type"] == "ValueError"
+        finally:
+            obs_crash.set_flight_sink(None)
+            obsm.set_enabled(prev_obs)
+
+
+# ------------------------------------------------ compactor (sat. 2)
+class TestCompactorStop:
+    def test_crash_then_stop_reraises(self, monkeypatch):
+        from raft_tpu.spatial.ann import mutation as mut
+
+        comp = mut.BackgroundCompactor()
+
+        def exploding(mindex, **kw):
+            raise RuntimeError("compaction exploded")
+
+        monkeypatch.setattr(mut, "compact", exploding)
+        assert comp.submit(object()) is True
+        deadline = time.monotonic() + 5
+        while comp.busy and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(RuntimeError, match="compaction exploded"):
+            comp.stop(timeout_s=5.0)
+        # the error is consumed exactly once: a second stop is quiet,
+        # and the compactor accepts new work again
+        comp.stop(timeout_s=5.0)
+        assert comp.submit(object()) is True
+        comp.join(5.0)
+
+    def test_stop_without_worker_is_quiet(self):
+        from raft_tpu.spatial.ann.mutation import BackgroundCompactor
+
+        BackgroundCompactor().stop(timeout_s=0.1)
+
+
+# ------------------------------------------------ executor race (sat. 3)
+class TestExecutorCloseRace:
+    def test_close_racing_submits_under_tracer(self, lockcheck_on):
+        """Submits racing close() either resolve or raise cleanly;
+        nothing wedges; the traced locks see zero order violations."""
+        from raft_tpu.resilience import AdmissionController
+        from raft_tpu.serving import ServingExecutor
+
+        lockcheck.load_pinned_order(
+            REPO / "ci" / "checks" / "lock_order.json")
+        dim = 4
+
+        def dispatch(batch, **_rt):
+            return (batch,)
+
+        ex = ServingExecutor(
+            dispatch, (2, 4), dim=dim, flush_age_s=0.001,
+            max_in_flight=2,
+            admission=AdmissionController(max_concurrent=4, max_queue=64),
+            flight=FlightRecorder(capacity=64, name="close-race"),
+        )
+        results = {"ok": 0, "closed": 0, "shed": 0}
+        res_lock = threading.Lock()
+        futures = []
+
+        def submitter(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(40):
+                try:
+                    f = ex.submit(rng.standard_normal(
+                        (2, dim)).astype(np.float32))
+                except errors.RaftLogicError:
+                    with res_lock:
+                        results["closed"] += 1
+                    return
+                except errors.RaftOverloadError:
+                    with res_lock:
+                        results["shed"] += 1
+                    continue
+                with res_lock:
+                    futures.append(f)
+
+        threads = [threading.Thread(target=submitter, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        ex.close(timeout_s=30)
+        for t in threads:
+            t.join(10)
+        assert not any(t.is_alive() for t in threads)
+        # both loops actually exited — no wedged drain thread
+        assert not ex._batcher.is_alive()
+        assert not ex._drainer.is_alive()
+        # every accepted in-flight future resolved (result or exception)
+        for f in futures:
+            assert f.done()
+            if f.exception() is None:
+                out = f.result()
+                assert out[0].shape == (2, dim)
+        # submits AFTER close raise cleanly
+        with pytest.raises(errors.RaftLogicError, match="closed"):
+            ex.submit(np.zeros((2, dim), np.float32))
+        # the tracer saw the pinned production order and nothing else
+        lockcheck.assert_clean()
+        assert not any(v.kind == "hold-while-dispatch"
+                       for v in lockcheck.violations())
+
+
+# ------------------------------------------------ the repo self-gate
+@pytest.mark.slow
+def test_repo_threads_clean():
+    """`python -m raft_tpu.analysis --threads` over the gated tree:
+    zero findings, zero drift, cycle-free — the `ci/run.sh threads`
+    gate as a test."""
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--threads",
+         "--lock-order", "ci/checks/lock_order.json",
+         "raft_tpu", "tests", "bench", "ci", "bench.py",
+         "__graft_entry__.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
