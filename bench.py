@@ -1349,6 +1349,15 @@ _PRINT_KEYS = {
     "zipf_s", "n_templates", "uncached_qps", "cached_qps",
     "qps_uplift", "cache_hit_rate", "coalesce_rate",
     "p99_ms_cached", "p99_ms_uncached", "cached_identical",
+    # the cold-tier row (ISSUE 17, docs/tiering.md "Reading the bench
+    # row"): same index served at 1/capacity_x the HBM budget —
+    # capacity_x / recall_vs_hot / bounded p99 are the acceptance,
+    # tier_hit_rate_* the hit-rate-vs-QPS curve, fetch_overlap_pct the
+    # async double-buffer evidence
+    "capacity_x", "n_slots", "tiered_qps", "hot_qps",
+    "qps_ratio_vs_hot", "tier_hit_rate", "fetch_overlap_pct",
+    "recall_vs_hot", "tier_degraded", "tier_fetches",
+    "tier_hit_rate_50", "tier_hit_rate_80", "tier_hit_rate_95",
 }
 
 
@@ -1372,6 +1381,12 @@ _TRIM_ORDER = (
     # uplift/hit-rate evidence does
     "n_templates", "zipf_s", "cached_identical", "coalesce_rate",
     "p99_ms_uncached", "uncached_qps",
+    # cold_tier secondaries fall first; capacity_x / recall_vs_hot /
+    # tier_hit_rate / tiered_qps / qps_ratio_vs_hot /
+    # fetch_overlap_pct / tier_hit_rate_95 are acceptance evidence and
+    # stay untrimmable
+    "n_slots", "tier_fetches", "tier_degraded",
+    "tier_hit_rate_50", "tier_hit_rate_80", "hot_qps",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",

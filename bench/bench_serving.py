@@ -722,13 +722,229 @@ def zipf_hot_traffic_row(make_run, qall, *, k: int,
     return row
 
 
+def cold_tier_row(index, qall, *, k: int, n_probes: int,
+                  capacity_x: float = 4.0, buckets=(128, 1024),
+                  request_size: int = 16, n_templates: int = 64,
+                  zipf_s: float = 1.1, n_requests: int = 256,
+                  flush_age_s: float = 0.002, max_in_flight: int = 4,
+                  chain=(4, 32), escalate: int = 2, seed: int = 29,
+                  min_duration_s: float = 0.5,
+                  max_requests: int = 20_000,
+                  fracs=(0.5, 0.8, 0.95)) -> dict:
+    """The popularity-tiered cold-tier row (ISSUE 17, docs/tiering.md
+    "Reading the bench row"): the SAME index served two ways at fixed
+    hardware — fully resident (``hot_qps``, the baseline every tier
+    claim is priced against) vs through a
+    :class:`~raft_tpu.tier.TieredListStore` whose hot "HBM" budget is
+    ``1/capacity_x`` of the cold slab's bytes (``tiered_qps``), under
+    the Zipf(``zipf_s``) template mix the tier exists for. Stamps:
+
+    * ``capacity_x`` — measured cold/hot byte ratio (the >= 4x
+      acceptance: the tier SERVES an index 4x its hot budget);
+    * ``qps_ratio_vs_hot`` + the ``p99_ms_{50,80,95}`` sweep at
+      fractions of the TIERED arm's own saturation (bounded p99);
+    * ``tier_hit_rate`` (+ per-sweep-point ``tier_hit_rate_{tag}``) —
+      the hit-rate-vs-QPS curve, post-convergence;
+    * ``recall_vs_hot`` — measured id-overlap recall of the tiered
+      answer vs the full-resident program ON the template traffic
+      (the >= 0.95 acceptance);
+    * ``fetch_overlap_pct`` — fetch spans stamped compute-overlapped
+      (the executor was mid-flight), the async double-buffer evidence.
+
+    The hot working set is converged ONCE (a gentle warm pass + fetcher
+    drain) before any measured arm: the row prices the steady state,
+    not the cold start — cold-start behavior is the degraded-probe
+    guardrail's territory (tests/test_tier.py)."""
+    from bench.common import chained_dispatch_stats
+    from raft_tpu.resilience import AdmissionController
+    from raft_tpu.serving import BucketSet, ServingExecutor
+    from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
+    from raft_tpu.testing.load import poisson_arrivals
+    from raft_tpu.tier import (
+        PromotionPolicy, SlabFetcher, TieredListStore,
+    )
+
+    bset = BucketSet.of(buckets)
+    q_pool = np.asarray(qall, np.float32)
+    d = int(q_pool.shape[1])
+    qcaps = {b: index.warmup(b, k=k, n_probes=n_probes)
+             for b in bset.sizes}
+
+    def make_hot(b):
+        def run(qq, qcap=qcaps[b]):
+            return ivf_flat_search_grouped(
+                index, qq, k, n_probes=n_probes, qcap=qcap,
+            )
+        return run
+
+    runs = {b: make_hot(b) for b in bset.sizes}
+
+    def hot_dispatch(batch, **_rt):
+        return runs[int(batch.shape[0])](batch)
+
+    for b in bset.sizes:
+        jax.block_until_ready(runs[b](jnp.zeros((b, d), jnp.float32)))
+
+    # the tier under test: hot budget = cold bytes / capacity_x
+    storage = index.storage
+    itemsize = np.asarray(index.data_sorted).dtype.itemsize
+    cold_bytes = int(storage.n) * d * itemsize
+    store = TieredListStore(
+        index, hbm_budget_bytes=max(1, int(cold_bytes // capacity_x)),
+        name="cold_tier", min_recall=0.95, touch_decay=0.95,
+    )
+    L = int(storage.max_list)
+    big = bset.largest
+    row = {
+        "engine": "ivf_flat", "scenario": "cold_tier", "nq": big,
+        "request_size": int(request_size), "zipf_s": float(zipf_s),
+        "n_templates": int(n_templates), "n_slots": store.n_slots,
+        "capacity_x": round(
+            cold_bytes / (store.n_slots * L * d * itemsize), 2),
+    }
+
+    qb = jnp.asarray(q_pool[:big])
+    st = chained_dispatch_stats(
+        lambda s: qb * (1.0 + 1e-6 * s), runs[big],
+        n1=chain[0], n2=chain[1], escalate=escalate,
+    )
+    if st is not None:
+        program_qps = big / (st["ms"] / 1e3)
+        row["spread"] = st["spread"]
+        row["repeats"] = st["repeats"]
+    else:
+        # jitter-dominated host: a crude timed denominator beats
+        # shipping no tier evidence at all (stamped by the missing
+        # spread/repeats)
+        t0 = time.perf_counter()
+        for s in range(3):
+            jax.block_until_ready(runs[big](qb * (1.0 + 1e-6 * s)))
+        program_qps = 3 * big / max(time.perf_counter() - t0, 1e-9)
+    row["program_qps"] = round(program_qps, 1)
+
+    # the fixed Zipf template pool (the zipf_hot_traffic discipline:
+    # hot templates re-arrive bitwise identical)
+    rng = np.random.default_rng(seed)
+    pool = np.stack([
+        q_pool[rng.integers(0, q_pool.shape[0], size=request_size)]
+        * (1.0 + 1e-6 * (t + 1))
+        for t in range(n_templates)
+    ])
+
+    ex_box = {}
+
+    def busy() -> bool:
+        ex = ex_box.get("ex")
+        return bool(ex is not None and ex.stats().in_flight > 0)
+
+    def tier_dispatch(batch, tier=None, **_rt):
+        return store.search(
+            batch, k, n_probes=n_probes,
+            qcap=qcaps[int(batch.shape[0])], runtime=tier,
+        )
+
+    def fresh_executor(tiered: bool):
+        ex = ServingExecutor(
+            tier_dispatch if tiered else hot_dispatch, bset, dim=d,
+            flush_age_s=flush_age_s, max_in_flight=max_in_flight,
+            admission=AdmissionController(
+                max_concurrent=max(1, 4 * big // request_size),
+                max_queue=max(8, 4 * big // request_size),
+            ),
+            runtime_provider=store.runtime if tiered else None,
+        )
+        ex_box["ex"] = ex
+        return ex
+
+    def n_for(rate_rps):
+        return int(min(max_requests,
+                       max(n_requests, min_duration_s * rate_rps)))
+
+    def drive(ex, rate_rps, seed_pt):
+        sched = poisson_arrivals(
+            rate_rps, n_for(rate_rps), seed=seed_pt,
+            sizes=request_size, zipf_s=zipf_s,
+            n_templates=n_templates,
+        )
+        return _drive_open_loop(
+            ex, sched, q_pool, seed=seed_pt,
+            rows_fn=lambda i, _size, s=sched: pool[
+                int(s.template_ids[i])],
+        )
+
+    policy = PromotionPolicy(demote_margin=1.25, min_touches=2.0,
+                             max_moves=8)
+    fetcher = SlabFetcher(store, window=4, policy=policy,
+                          busy_fn=busy,
+                          max_pending=4 * store.n_slots)
+    try:
+        # converge the hot set off the clock (misses -> async fills)
+        with fresh_executor(True) as ex:
+            drive(ex, max(1.0, 0.25 * program_qps / request_size),
+                  seed + 3)
+        fetcher.drain(60.0)
+        s0 = store.stats()
+
+        rate = 1.5 * program_qps / request_size
+        with fresh_executor(False) as ex:
+            _, _, hot_qps, _ = drive(ex, rate, seed)
+        with fresh_executor(True) as ex:
+            _, _, tiered_qps, _ = drive(ex, rate, seed)
+        row["hot_qps"] = round(hot_qps, 1)
+        row["tiered_qps"] = round(tiered_qps, 1)
+        if hot_qps > 0:
+            row["qps_ratio_vs_hot"] = round(tiered_qps / hot_qps, 3)
+
+        # the hit-rate-vs-QPS sweep at fractions of the TIERED arm's
+        # own measured saturation
+        for frac in fracs:
+            tag = f"{int(round(frac * 100))}"
+            offered = frac * tiered_qps / request_size
+            if offered <= 0:
+                continue
+            pre = store.stats()
+            with fresh_executor(True) as ex:
+                lat_ms, _, _, _ = drive(ex, offered,
+                                        seed + int(frac * 100))
+            post = store.stats()
+            hits = post.probe_hits - pre.probe_hits
+            misses = post.probe_misses - pre.probe_misses
+            if hits + misses:
+                row[f"tier_hit_rate_{tag}"] = round(
+                    hits / (hits + misses), 3)
+            if lat_ms:
+                row[f"p99_ms_{tag}"] = round(
+                    float(np.percentile(np.asarray(lat_ms), 99)), 3)
+
+        send = store.stats()
+        dh = send.probe_hits - s0.probe_hits
+        dm = send.probe_misses - s0.probe_misses
+        if dh + dm:
+            row["tier_hit_rate"] = round(dh / (dh + dm), 3)
+        row["fetch_overlap_pct"] = round(send.fetch_overlap_pct, 1)
+        row["tier_fetches"] = send.fetches
+    finally:
+        fetcher.close()
+
+    # measured recall of the tiered answer vs the full-resident
+    # program ON the template traffic, post-convergence (the >= 0.95
+    # acceptance; measure_recall also feeds the tier_recall gauge)
+    recalls = [
+        store.measure_recall(pool[t], k, n_probes=n_probes)
+        for t in range(min(8, n_templates))
+    ]
+    row["recall_vs_hot"] = round(float(np.mean(recalls)), 4)
+    row["tier_degraded"] = bool(store.degraded)
+    return row
+
+
 def serving_latency_rows(
     n: int = 500_000, d: int = 96, k: int = 10, n_probes: int = 16,
     n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
                                            "ivf_pq"),
     chain=(4, 32), escalate: int = 2,
     hedged: bool = True, overload: bool = True, mixed: bool = True,
-    open_loop: bool = True, zipf: bool = True,
+    open_loop: bool = True, zipf: bool = True, cold_tier: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
@@ -924,6 +1140,26 @@ def serving_latency_rows(
         except Exception as e:                       # noqa: BLE001
             rows.append({
                 "engine": "ivf_flat", "scenario": "zipf_hot_traffic",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+
+    # the popularity-tiered cold-tier row (ISSUE 17): same index at
+    # 1/4 the "HBM" budget, hit-rate-vs-QPS sweep + recall-vs-hot
+    if cold_tier and "ivf_flat" in engines:
+        try:
+            t_buckets = tuple(sorted({nq for nq in nqs if nq > 1})
+                              or {max(nqs)})
+            rows.append(cold_tier_row(
+                get_index("ivf_flat"), np.asarray(qall), k=k,
+                n_probes=n_probes, buckets=t_buckets,
+                request_size=max(1, min(16, max(t_buckets) // 8)),
+                n_templates=min(64, max(8, 4 * len(t_buckets) * 8)),
+                n_requests=min(256, 32 * len(t_buckets) * 4),
+                chain=chain, escalate=escalate,
+            ))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "ivf_flat", "scenario": "cold_tier",
                 "error": f"{type(e).__name__}: {e}"[:160],
             })
 

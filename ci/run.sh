@@ -108,6 +108,17 @@ run_tests() {
         python -m pytest tests/ -q
 }
 
+run_tier_smoke() {
+    # Cold-tier smoke (ISSUE 17, docs/tiering.md): CPU host-sim with a
+    # tiny HBM budget so the store is FORCED through the interesting
+    # paths — promotion, policy demotion, degraded cold probes, async
+    # fetch, mutation-epoch invalidation — plus the zero-retrace
+    # cache-size audits and the cold_tier bench row end to end. Fails
+    # fast before the long mesh run (which repeats it).
+    echo "== cold-tier smoke (tests/test_tier.py) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_tier.py -q
+}
+
 run_multihost_smoke() {
     # CPU-only 2-process host-sim smoke (ISSUE 9): the multiproc
     # rendezvous workers build the (num_procs, 2) HierarchicalComms
@@ -146,10 +157,12 @@ case "$stage" in
     test) run_tests ;;
     x64) run_x64 ;;
     docs) run_docs ;;
+    tier) run_tier_smoke ;;
     multihost) run_multihost_smoke ;;
     all) run_style; run_programs; run_threads; run_install_check; \
-         run_docs; run_x64; run_multihost_smoke; run_tests ;;
-    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|multihost|all)"
+         run_docs; run_x64; run_tier_smoke; run_multihost_smoke; \
+         run_tests ;;
+    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|tier|multihost|all)"
        exit 2 ;;
 esac
 echo "CI: OK"

@@ -379,6 +379,58 @@ def _sq_pallas(w: _World, count: bool) -> ProgramRecord:
     )
 
 
+@_spec("ivf_flat_grouped_tiered",
+       "single-chip grouped flat scan over the hot-tier slot view "
+       "(raft_tpu/tier, docs/tiering.md) — tier membership as runtime "
+       "operands; the promotion/demotion/tombstone flip census runs "
+       "here")
+def _flat_tiered(w: _World, count: bool) -> ProgramRecord:
+    import jax.numpy as jnp
+
+    from raft_tpu.obs.metrics import MetricRegistry
+    from raft_tpu.spatial.ann.ivf_flat import _grouped_impl
+    from raft_tpu.tier import TieredListStore
+
+    store = w._memo("tier", lambda: TieredListStore(
+        w.flat_index, n_slots=4, name="audit-tier",
+        registry=MetricRegistry(),
+    ))
+    q0 = jnp.zeros((_NQ, _D), jnp.float32)
+    lb = max(1, min(8, _LISTS))
+
+    def prep(hot, dead=None):
+        # membership flips are HOST transactions on the store; the
+        # census asks whether the program each published snapshot
+        # prepares is always the same one (offsets/sizes/ids/data/mask
+        # are runtime operands — promote/demote/tombstone must never
+        # retrace)
+        store.demote(list(range(_LISTS)))
+        if hot:
+            store.promote(hot)
+        if dead is not None:
+            with store._install:    # a tombstone VALUE flip
+                store._mask_np = store._mask_np.copy()
+                store._mask_np[dead] = 0
+                store._publish()
+        snap = store.runtime()["tier"]
+        args = (snap.view, q0, _K, _P, _QCAP, lb, None, None,
+                snap.row_mask)
+        return _grouped_impl, args, None
+
+    flips = [dict(hot=(0, 1, 2, 3)), dict(hot=(4, 5)), dict(hot=()),
+             dict(hot=(0, 1, 2, 3), dead=5)]
+    fn, args, _ = prep(**flips[0])
+    traced = fn.trace(*args, use_pallas=False, pallas_interpret=False)
+    return record_from_traced(
+        "ivf_flat_grouped_tiered", traced,
+        {"nq": _NQ, "k": _K, "n_probes": _P, "qcap": _QCAP,
+         "n_slots": 4,
+         "max_list": int(w.flat_index.storage.max_list),
+         "tiered": True, "engine": "xla", "allow_wide_tile": True},
+        program_count=flip_census(prep, flips) if count else None,
+    )
+
+
 @_spec("two_level_probe_kernel",
        "fused two-level coarse probe, kernelized through the shared "
        "scan core")

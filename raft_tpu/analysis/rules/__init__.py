@@ -25,6 +25,7 @@ def _collect() -> List[Rule]:
         adc_gather,
         api_compat,
         dcn_wide_collective,
+        host_fetch_in_traced_body,
         metrics_in_traced_body,
         mutation_retrace,
         prng_discipline,
@@ -40,7 +41,7 @@ def _collect() -> List[Rule]:
                 x64_hygiene, prng_discipline, adc_gather,
                 mutation_retrace, sync_in_hot_path,
                 dcn_wide_collective, metrics_in_traced_body,
-                stale_epoch_read):
+                host_fetch_in_traced_body, stale_epoch_read):
         out.extend(mod.RULES)
     return out
 

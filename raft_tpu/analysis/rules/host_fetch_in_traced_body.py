@@ -1,0 +1,163 @@
+"""Rule ``host-fetch-in-traced-body``: a host→device fetch, a tier
+membership mutation, or a pinned-slab read inside a jitted/traced body.
+
+The tier (raft_tpu/tier/, docs/tiering.md) lives on a strict split:
+the COLD slab is host memory, and the ONLY paths that touch it are
+host-side — :meth:`TieredListStore.fetch_slab` reads it, the install
+path ``jax.device_put`` s it, and the membership methods republish the
+runtime snapshot. Any of these inside a traced body breaks the design
+twice over:
+
+* ``jax.device_put(...)`` at trace time embeds the CURRENT slab as a
+  compile-time CONSTANT: the program serves that frozen snapshot
+  forever, every promotion after it is invisible, and a slab-sized
+  constant is baked into the executable (an HBM copy per cached
+  variant — the exact wall the tier exists to break);
+* a tier-store call (``fetch_slab``/``promote``/``apply_moves``/
+  ``sync_mutations``/...) is Python state + locks: it runs ONCE at
+  trace time, so the compiled program never fetches, never promotes,
+  and never sees another mutation epoch — the serving answer silently
+  pins to the trace-time membership;
+* a pinned host-slab subscript read (``self._data_np[...]``,
+  ``host_slab[...]``, ``cold_rows[...]``) is the same constant-bake in
+  disguise — numpy indexing traces to a constant operand.
+
+The tier's contract is the executor's: fetch on the HOST (the
+fetcher thread, the ``runtime_provider`` hook), hand the traced body
+ONLY device arrays as runtime operands. Genuine trace-time constants
+that happen to share a spelling carry
+``# jaxlint: disable=host-fetch-in-traced-body`` on the line (or live
+in ci/checks/jaxlint_baseline.json).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional
+
+from raft_tpu.analysis.rules import Rule
+
+# the host→device staging entry points — at trace time each bakes its
+# operand into the program as a constant
+_DEVICE_PUTS = {
+    "jax.device_put",
+    "jax.device_put_sharded",
+    "jax.device_put_replicated",
+}
+
+# tier-store methods whose bodies are host state + locks; `fetch_slab`
+# is distinctive enough to flag on ANY receiver, the rest only on a
+# tier-shaped one (a generic `plan.promote()` must not match)
+_TIER_ALWAYS = {"fetch_slab"}
+_TIER_METHODS = {
+    "promote", "demote", "apply_moves", "rebalance",
+    "sync_mutations", "refresh_host", "request",
+}
+_TIER_RECV = re.compile(
+    r"(^|_)(tier|tiered|store|slab|fetcher)($|_|s$)"
+)
+
+# pinned host-slab spellings for the subscript-read heuristic: the
+# repo's own host-mirror convention is the `_np` suffix
+# (`self._data_np`), plus the generic host/pinned/cold tokens
+_HOST_BUF = re.compile(
+    r"(_np$|(^|_)(host|pinned|cold)($|_))"
+)
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """Name/Attribute chain with dots normalized to underscores
+    (``self._data_np`` -> ``self__data_np``), or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return "_".join(reversed(parts))
+    return None
+
+
+class HostFetchInTracedBodyRule(Rule):
+    name = "host-fetch-in-traced-body"
+    description = (
+        "host->device fetch (device_put), tier-store call, or pinned "
+        "host-slab read inside a traced body — runs once at trace "
+        "time and bakes the slab in as a constant"
+    )
+
+    def _device_put(self, ctx, call: ast.Call) -> Optional[str]:
+        d = ctx.facts.dotted(call.func)
+        if d in _DEVICE_PUTS:
+            return d
+        return None
+
+    def _tier_call(self, ctx, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        if fn.attr in _TIER_ALWAYS:
+            recv = _dotted_name(fn.value) or "<store>"
+            return f"{recv}.{fn.attr}()"
+        if fn.attr in _TIER_METHODS:
+            recv = _dotted_name(fn.value)
+            if recv is not None and _TIER_RECV.search(recv.lower()):
+                return f"{recv}.{fn.attr}()"
+        return None
+
+    def _host_read(self, node: ast.Subscript) -> Optional[str]:
+        # reads only: a Store/Del context is an ordinary host mutation
+        # some OTHER rule may care about, not a constant-bake
+        if not isinstance(node.ctx, ast.Load):
+            return None
+        d = _dotted_name(node.value)
+        if d is not None and _HOST_BUF.search(d.lower()):
+            return d
+        return None
+
+    def check(self, ctx) -> Iterator:
+        seen: set = set()          # nested traced fns share body nodes
+        for fn in ctx.facts.traced:
+            body = [
+                n for n in ctx.facts.traced_body_nodes(fn)
+                if id(n) not in seen and not seen.add(id(n))
+            ]
+            for node in body:
+                if isinstance(node, ast.Call):
+                    put = self._device_put(ctx, node)
+                    if put is not None:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"{put}(...) inside a traced body embeds "
+                            "its operand as a COMPILE-TIME constant — "
+                            "the program serves that frozen snapshot "
+                            "forever; stage on the host (the fetcher "
+                            "thread / runtime_provider) and pass the "
+                            "device array in as a runtime operand",
+                        )
+                        continue
+                    tier = self._tier_call(ctx, node)
+                    if tier is not None:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"{tier} inside a traced body runs ONCE at "
+                            "trace time — the compiled program never "
+                            "fetches, promotes, or syncs again; drive "
+                            "tier membership from the host and hand "
+                            "the body the published snapshot",
+                        )
+                elif isinstance(node, ast.Subscript):
+                    buf = self._host_read(node)
+                    if buf is not None:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"pinned host-slab read {buf}[...] inside "
+                            "a traced body traces to a baked-in "
+                            "constant operand — fetch on the host "
+                            "(TieredListStore.fetch_slab) and "
+                            "device_put OUTSIDE the traced body",
+                        )
+
+
+RULES = [HostFetchInTracedBodyRule()]

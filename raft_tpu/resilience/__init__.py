@@ -58,8 +58,10 @@ from raft_tpu.resilience.health import (
 from raft_tpu.resilience.replica import (
     FailoverPlan,
     ReplicaPlacement,
+    measured_list_load,
     measured_shard_load,
     popularity_replication,
+    record_list_load,
     record_shard_load,
     resolve_route,
 )
@@ -83,5 +85,7 @@ __all__ = [
     "resolve_route",
     "record_shard_load",
     "measured_shard_load",
+    "record_list_load",
+    "measured_list_load",
     "popularity_replication",
 ]

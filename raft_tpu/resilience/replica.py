@@ -47,6 +47,8 @@ __all__ = [
     "resolve_route",
     "record_shard_load",
     "measured_shard_load",
+    "record_list_load",
+    "measured_list_load",
     "popularity_replication",
 ]
 
@@ -270,6 +272,80 @@ def measured_shard_load(n_shards: int, *, registry=None,
         s = int(s)
         if 0 <= s < n_shards:
             load[s] += float(inst.value)
+    return load
+
+
+# per-LIST granularity (ISSUE 17, docs/tiering.md): the tier's
+# promotion policy needs to know which LISTS are hot, not just which
+# shards — but an index has thousands of lists and a counter per list
+# is a cardinality bomb. The rule: a shard mints at most
+# ``max_series`` per-list series (first-come under Zipf traffic ≈ the
+# head, which is exactly the set the tier can act on); everything else
+# folds into the ``list="other"`` bucket, so total traffic is still
+# conserved and the catalog stays bounded.
+
+_LIST_LOAD_METRIC = "serving_list_rows_total"
+_LIST_SERIES_CAP = 64
+
+
+def record_list_load(list_rows, *, shard: int = 0, registry=None,
+                     name: str = _LIST_LOAD_METRIC,
+                     max_series: int = _LIST_SERIES_CAP) -> None:
+    """Accumulate a per-list dispatched-row vector into the
+    bounded-cardinality ``{name}{shard=s,list=l}`` counters — the
+    measurement side of tier promotion
+    (:class:`raft_tpu.tier.PromotionPolicy`). ``list_rows`` is a
+    ``(n_lists,)`` count vector (a probe histogram, a touch decay
+    snapshot — whatever granularity the caller has). Lists that
+    already own a series always record to it; new series are minted
+    only while the shard holds fewer than ``max_series``, after which
+    the remainder lands in ``list="other"``. ``RAFT_TPU_OBS=off``
+    no-ops it like every recorder."""
+    rows = np.asarray(list_rows)
+    errors.expects(rows.ndim == 1,
+                   "record_list_load: expected a (n_lists,) vector, "
+                   "got %s", tuple(rows.shape))
+    reg = obs_metrics.default_registry() if registry is None else registry
+    shard_l = str(int(shard))
+    minted = set()
+    for inst in reg.series(name):
+        if (inst.labels.get("shard") == shard_l
+                and inst.labels.get("list") not in (None, "other")):
+            minted.add(inst.labels["list"])
+    other = 0
+    for lid in np.nonzero(rows)[0]:
+        n = int(rows[lid])
+        key = str(int(lid))
+        if key in minted or len(minted) < max_series:
+            minted.add(key)
+            reg.counter(name, shard=shard_l, list=key).inc(n)
+        else:
+            other += n
+    if other:
+        reg.counter(name, shard=shard_l, list="other").inc(other)
+
+
+def measured_list_load(n_lists: int, *, shard: "int | None" = None,
+                       registry=None,
+                       name: str = _LIST_LOAD_METRIC) -> np.ndarray:
+    """The accumulated per-list load, ``(n_lists,)`` float64 — the
+    promotion policy's ranking signal. ``shard=None`` sums every
+    shard's series; the ``list="other"`` residual bucket is excluded
+    (it names no actionable list)."""
+    errors.expects(n_lists >= 1,
+                   "measured_list_load: n_lists=%d < 1", n_lists)
+    reg = obs_metrics.default_registry() if registry is None else registry
+    load = np.zeros(n_lists, np.float64)
+    want = None if shard is None else str(int(shard))
+    for inst in reg.series(name):
+        lid = inst.labels.get("list")
+        if lid in (None, "other"):
+            continue
+        if want is not None and inst.labels.get("shard") != want:
+            continue
+        lid = int(lid)
+        if 0 <= lid < n_lists:
+            load[lid] += float(inst.value)
     return load
 
 

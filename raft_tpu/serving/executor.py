@@ -198,6 +198,16 @@ class ServingExecutor:
     ``failover=``); :meth:`set_runtime` swaps values mid-stream with
     zero retraces (they are runtime operands of the compiled program).
 
+    ``runtime_provider`` — optional per-dispatch runtime source
+    (ISSUE 17, docs/tiering.md): a zero-arg callable returning a dict
+    overlaid onto the runtime snapshot once per batch, sampled outside
+    the executor's locks just before staging. This is how a
+    :class:`~raft_tpu.tier.TieredListStore` hands each dispatch its
+    CURRENT hot-tier view without ``set_runtime`` churn — promotions
+    flip runtime operands, never statics, so no dispatch retraces. The
+    sampled overlay rides in the batch's in-flight record: a hedge
+    re-dispatch reuses the exact snapshot the primary saw.
+
     ``stage`` — host→device staging (default :func:`jax.device_put`);
     override to pin placement. ``donate`` is the caller's contract
     with its dispatch closure; the executor always re-stages hedged
@@ -239,6 +249,7 @@ class ServingExecutor:
         hedge: "HedgePolicy | float | None" = None,
         backup_dispatch: Optional[Callable[..., Any]] = None,
         runtime_inputs: Optional[Dict[str, Any]] = None,
+        runtime_provider: Optional[Callable[[], Dict[str, Any]]] = None,
         stage: Callable[[np.ndarray], Any] = jax.device_put,
         clock: Callable[[], float] = time.monotonic,
         name: str = "serving",
@@ -341,6 +352,14 @@ class ServingExecutor:
         self._hedged_batches = 0
         self._backup_wins = 0
         self._runtime: Dict[str, Any] = dict(runtime_inputs or {})
+        # per-dispatch runtime source (ISSUE 17, docs/tiering.md): a
+        # callable sampled once per batch, OUTSIDE the executor lock,
+        # whose dict overlays self._runtime — how a TieredListStore
+        # hands every dispatch the CURRENT hot-tier snapshot without a
+        # set_runtime round-trip per promotion. The sampled snapshot is
+        # pinned into the batch's _InFlight record so a hedge re-uses
+        # the exact operands the primary saw.
+        self._runtime_provider = runtime_provider
 
         # a dead batcher/drainer must not vanish silently: route
         # uncaught thread exceptions to thread_uncaught_total + a
@@ -698,6 +717,11 @@ class ServingExecutor:
         try:
             if self.admission is not None:
                 ticket = self.admission.begin_service(batch.n_requests)
+            # sample the per-dispatch runtime source (tier snapshots
+            # etc.) outside every lock — a provider may itself take a
+            # store lock, and must never nest under _done/_lock
+            if self._runtime_provider is not None:
+                runtime = {**runtime, **self._runtime_provider()}
             # stage the padded host buffer, then dispatch: both are
             # async against earlier batches still computing — this IS
             # the double buffer (donate-friendly: hedges re-stage from

@@ -88,6 +88,7 @@ __all__ = [
     "compact",
     "compaction_stats",
     "delete",
+    "lists_changed_since",
     "mutable_search",
     "mutable_warmup",
     "probe_overlap",
@@ -204,6 +205,16 @@ class MutableIndex:
         # lookup. Host state only; never serialized (a loaded
         # checkpoint restarts at 0 with an empty cache beside it).
         self.epoch: int = 0
+        # the EPOCH JOURNAL (ISSUE 17, docs/tiering.md "Epoch
+        # invalidation"): per-bump ``(epoch, lists|None)`` entries
+        # naming which lists' serving state changed (None = everything
+        # — compaction rewrote the slab). The tiered store's
+        # ``sync_mutations`` reads it through
+        # :func:`lists_changed_since`; bounded — entries past the cap
+        # fall off and queries below the floor answer None (refresh
+        # everything, the safe direction). Host state only.
+        self._epoch_journal: list = []
+        self._journal_floor: int = 0
 
     @property
     def n_lists(self) -> int:
@@ -226,7 +237,67 @@ def _with(mindex: MutableIndex, **kw) -> MutableIndex:
     out.dirty_lists = set(mindex.dirty_lists)
     out.name = mindex.name
     out.epoch = mindex.epoch
+    out._epoch_journal = list(mindex._epoch_journal)
+    out._journal_floor = mindex._journal_floor
     return out
+
+
+_EPOCH_JOURNAL_CAP = 1024
+
+
+def _journal_note(mindex: MutableIndex, changed) -> None:
+    """Append one epoch-journal entry for ``mindex.epoch`` (call AFTER
+    the bump). ``changed``: the list ids whose serving state the write
+    touched, or None = everything (compaction). Bounded at
+    ``_EPOCH_JOURNAL_CAP`` — dropped entries raise the floor, below
+    which :func:`lists_changed_since` answers None."""
+    j = mindex._epoch_journal
+    j.append((mindex.epoch,
+              None if changed is None else frozenset(changed)))
+    if len(j) > _EPOCH_JOURNAL_CAP:
+        drop = len(j) - _EPOCH_JOURNAL_CAP
+        mindex._journal_floor = j[drop - 1][0]
+        del j[:drop]
+
+
+def lists_changed_since(mindex: MutableIndex, epoch: int):
+    """The tier-invalidation query (docs/tiering.md): the set of list
+    ids whose serving state changed in epochs ``(epoch,
+    mindex.epoch]``, or ``None`` when the answer is "assume
+    everything" — a compaction sits in the window, or the window
+    predates the bounded journal. An up-to-date reader gets an empty
+    set. The set may OVER-approximate (a delete of an already-dead id
+    can name its list) — safe for invalidation, never under-reports."""
+    if epoch >= mindex.epoch:
+        return set()
+    if epoch < mindex._journal_floor:
+        return None
+    out: set = set()
+    for e, changed in mindex._epoch_journal:
+        if e <= epoch:
+            continue
+        if changed is None:
+            return None
+        out |= changed
+    return out
+
+
+def _main_slab_lists(mindex: MutableIndex, ids_np: np.ndarray) -> set:
+    """Lists owning the MAIN-slab rows of the given ids (host
+    searchsorted over the list offsets) — the row_mask tombstone side
+    of an epoch-journal entry. Over-approximates: an id whose main row
+    was already dead still names its list."""
+    span = int(mindex.id_to_pos.shape[0])
+    inb = (ids_np >= 0) & (ids_np < span)
+    if not inb.any():
+        return set()
+    pos = np.asarray(mindex.id_to_pos[jnp.asarray(ids_np[inb])])
+    pos = pos[pos >= 0]
+    if pos.size == 0:
+        return set()
+    offs = np.asarray(mindex.index.storage.list_offsets)
+    lists = np.searchsorted(offs, pos, side="right") - 1
+    return set(int(x) for x in lists)
 
 
 def wrap_mutable(index, *, delta_cap: int = 32,
@@ -428,6 +499,14 @@ def upsert(mindex: MutableIndex, vectors, ids):
         # results must go stale (docs/serving.md "Hot traffic"); an
         # all-rejected batch changed nothing and keeps the cache warm
         out.epoch = mindex.epoch + 1
+        changed = set(np.asarray(lbl)[accepted_np].tolist())
+        changed |= set(
+            np.nonzero(np.asarray(dirty_sup))[0].tolist())
+        # a superseded MAIN copy flips a tombstone in its list's slab
+        # range — the tier journal must name that list too
+        changed |= _main_slab_lists(
+            mindex, np.asarray(idarr)[accepted_np])
+        _journal_note(out, changed)
     return out, accepted_np
 
 
@@ -451,6 +530,11 @@ def delete(mindex: MutableIndex, ids):
         # a delete that actually removed a live row invalidates cached
         # results, exactly like an applied upsert
         out.epoch = mindex.epoch + 1
+        changed = set(np.nonzero(np.asarray(dirty))[0].tolist())
+        # a main-slab hit flips row_mask inside its list's slab range;
+        # the journal must name that list for tier invalidation
+        changed |= _main_slab_lists(mindex, np.asarray(idarr))
+        _journal_note(out, changed)
     ms = _mseries(mindex.name)
     ms["op_ms"]["delete"].observe((time.perf_counter() - t0) * 1e3)
     n_found = int(found_np.sum())
@@ -926,6 +1010,10 @@ def compact(
     # must not RESET (wrap_mutable starts at 0; a reset would mark old
     # cache entries fresh again)
     out.epoch = mindex.epoch + 1
+    # compaction re-sorts every slab: a None journal entry tells
+    # :func:`lists_changed_since` "everything" — tier consumers must do
+    # a full host refresh, not a per-list invalidation
+    _journal_note(out, None)
     stats["max_list"] = st.max_list
     stats["n_slab"] = nb
     ms = _mseries(mindex.name)

@@ -1,0 +1,25 @@
+"""Popularity-tiered two-tier slab storage — cold IVF lists in host
+RAM, a hot working set in HBM, promotion/demotion as zero-retrace
+runtime-operand flips of the unchanged grouped serving program
+(ROADMAP item 4; docs/tiering.md).
+
+* :class:`TieredListStore` — the store: host cold authority, hot-slot
+  view index, copy-publish installs, mutation-epoch invalidation, the
+  measured recall guardrail;
+* :class:`SlabFetcher` — the async host→device promotion worker
+  (bounded queue + in-flight window, flight-recorded fetch spans);
+* :class:`PromotionPolicy` — hysteresis planning over the measured
+  per-list load signal.
+"""
+
+from raft_tpu.tier.fetch import SlabFetcher
+from raft_tpu.tier.policy import PromotionPolicy
+from raft_tpu.tier.store import TieredListStore, TierRuntime, TierStats
+
+__all__ = [
+    "PromotionPolicy",
+    "SlabFetcher",
+    "TierRuntime",
+    "TierStats",
+    "TieredListStore",
+]

@@ -1347,6 +1347,129 @@ def test_metrics_in_traced_body_suppression_honored():
     assert out == []
 
 
+# -- host-fetch-in-traced-body ------------------------------------------------
+
+def hostfetch_findings(src):
+    return findings(src, "host-fetch-in-traced-body")
+
+
+def test_host_fetch_flags_device_put_in_traced_body():
+    # the constant-bake: device_put at trace time freezes the slab
+    # into the executable — every promotion after it is invisible
+    out = hostfetch_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, slab):
+            dev = jax.device_put(slab)
+            return x + dev
+    """)
+    assert len(out) == 1
+    assert "COMPILE-TIME constant" in out[0].message
+
+
+def test_host_fetch_flags_device_put_import_form():
+    out = hostfetch_findings("""
+        from jax import device_put
+        import jax
+
+        @jax.jit
+        def body(x, slab):
+            return x + device_put(slab)
+    """)
+    assert len(out) == 1
+
+
+def test_host_fetch_flags_tier_store_calls():
+    # fetch_slab fires on ANY receiver; membership methods only on a
+    # tier-shaped one
+    out = hostfetch_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, store, tier_store):
+            slab, ids, pos = store.fetch_slab(3)
+            tier_store.promote([3])
+            tier_store.sync_mutations(None)
+            return x
+    """)
+    assert len(out) == 3
+    msgs = " ".join(f.message for f in out)
+    assert "trace time" in msgs
+
+
+def test_host_fetch_generic_promote_unflagged():
+    # `plan.promote()` on a non-tier-shaped receiver must not match —
+    # promote/request are ordinary verbs elsewhere
+    out = hostfetch_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, plan, session):
+            plan.promote([1])
+            session.request([2])
+            return x
+    """)
+    assert out == []
+
+
+def test_host_fetch_flags_pinned_slab_read():
+    # the repo's host-mirror convention (`self._data_np`) and the
+    # generic host/pinned/cold tokens — a subscript READ traces to a
+    # baked-in constant
+    out = hostfetch_findings("""
+        import jax
+
+        @jax.jit
+        def body(self, x, host_slab, i):
+            a = self._data_np[3:7]
+            b = host_slab[i]
+            return x + a.sum() + b
+    """)
+    assert len(out) == 2
+    assert "constant operand" in out[0].message
+
+
+def test_host_fetch_device_subscript_unflagged():
+    # ordinary device-array indexing inside a traced body is the
+    # normal pattern — only host-shaped receivers match
+    out = hostfetch_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, offsets, i):
+            return x + offsets[i]
+    """)
+    assert out == []
+
+
+def test_host_fetch_host_path_clean():
+    # the intended pattern — the fetcher thread stages on the host and
+    # the traced body sees only runtime operands — is exactly
+    # TieredListStore._install_list; nothing traced, nothing flagged
+    out = hostfetch_findings("""
+        import jax
+
+        def install(store, slot, lid):
+            slab, ids, pos = store.fetch_slab(lid)
+            dev = jax.device_put(slab)
+            return dev
+    """)
+    assert out == []
+
+
+def test_host_fetch_suppression_honored():
+    out = hostfetch_findings("""
+        import jax
+
+        @jax.jit
+        def body(x, slab):
+            dev = jax.device_put(slab)  # jaxlint: disable=host-fetch-in-traced-body
+            return x + dev
+    """)
+    assert out == []
+
+
 # -- engine: baseline, CLI, self-gate ---------------------------------------
 
 FIXTURE_BAD = textwrap.dedent("""

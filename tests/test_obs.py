@@ -705,6 +705,60 @@ class TestAdmissionMetrics:
                          controller="t").value >= 0.0
 
 
+# ------------------------------------------------- per-list load feed
+class TestListLoadMetrics:
+    def test_round_trip_and_shard_filter(self):
+        from raft_tpu.resilience import (
+            measured_list_load, record_list_load,
+        )
+
+        reg = MetricRegistry()
+        record_list_load([3, 0, 2, 0], shard=0, registry=reg)
+        record_list_load([1, 1, 0, 0], shard=0, registry=reg)
+        record_list_load([0, 7, 0, 0], shard=1, registry=reg)
+        np.testing.assert_array_equal(
+            measured_list_load(4, shard=0, registry=reg), [4, 1, 2, 0])
+        np.testing.assert_array_equal(
+            measured_list_load(4, registry=reg), [4, 8, 2, 0])
+
+    def test_bounded_cardinality_folds_into_other(self):
+        """The cardinality rule: a shard mints at most ``max_series``
+        per-list series; the remainder folds into ``list="other"`` so
+        traffic totals are conserved and the catalog stays bounded."""
+        from raft_tpu.resilience import (
+            measured_list_load, record_list_load,
+        )
+
+        reg = MetricRegistry()
+        rows = np.arange(1, 9)          # 8 lists, loads 1..8
+        record_list_load(rows, shard=0, registry=reg, max_series=3)
+        per_list = [
+            inst for inst in reg.series("serving_list_rows_total")
+            if inst.labels.get("list") != "other"
+        ]
+        assert len(per_list) == 3
+        other = [
+            inst for inst in reg.series("serving_list_rows_total")
+            if inst.labels.get("list") == "other"
+        ]
+        assert len(other) == 1
+        total = sum(float(i.value)
+                    for i in reg.series("serving_list_rows_total"))
+        assert total == float(rows.sum())       # conserved
+        # minted series keep recording; measured_ excludes "other"
+        record_list_load(rows, shard=0, registry=reg, max_series=3)
+        assert measured_list_load(8, registry=reg).sum() > 0
+
+    def test_default_registry_emission(self):
+        # record once into the process registry so the live-registry
+        # side of the catalog-parity scan sees the dynamic name
+        from raft_tpu.resilience import record_list_load
+
+        record_list_load([1, 0], shard=7)
+        names = obsm.default_registry().snapshot()
+        assert "serving_list_rows_total" in names
+
+
 # -------------------------------------------- metric-catalog parity
 class TestMetricCatalogParity:
     def test_every_emitted_series_has_a_catalog_row(self):

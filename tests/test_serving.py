@@ -378,8 +378,9 @@ def test_serving_latency_rows_tiny_config():
         n=8192, d=8, k=4, n_probes=4, n_lists=8, nqs=(1, 4),
         engines=("ivf_flat",), chain=(1, 3), escalate=0,
         hedged=False, overload=False, mixed=False, open_loop=False,
-        zipf=False,    # the zipf_hot_traffic row has its own smoke
-    )                  # (tests/test_result_cache.py)
+        zipf=False,       # the zipf_hot_traffic row has its own smoke
+        cold_tier=False,  # (tests/test_result_cache.py); the cold_tier
+    )                     # row's smoke lives in tests/test_tier.py
     assert out["unit"] == "ms"
     assert [r["nq"] for r in out["rows"]] == [1, 4]
     for r in out["rows"]:
@@ -1257,5 +1258,97 @@ def test_round15_bench_line_parses_with_zipf_hot_traffic():
         assert key not in benchtop._TRIM_ORDER
     for key in ("zipf_s", "n_templates", "cached_identical",
                 "coalesce_rate", "p99_ms_uncached", "uncached_qps"):
+        assert key in benchtop._PRINT_KEYS
+        assert key in benchtop._TRIM_ORDER
+
+
+def test_round17_bench_line_parses_with_cold_tier():
+    """ISSUE 17 satellite (the _fit_line parse/cap test extended,
+    following the r05-r15 pattern): the round-17 artifact shape — every
+    prior row PLUS the ``cold_tier`` row (same index served at
+    1/capacity_x the HBM budget through the popularity tier,
+    docs/tiering.md "Reading the bench row") — must print as a line
+    that json.loads-round-trips under the 1800-char driver cap, with
+    the acceptance keys (``capacity_x``, ``recall_vs_hot``,
+    ``tier_hit_rate``, ``tiered_qps``, ``qps_ratio_vs_hot``,
+    ``fetch_overlap_pct``, ``tier_hit_rate_95``) untrimmable."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r17", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01}
+        for i in range(8)
+    ] + [
+        # the round-15 hot-traffic row, unchanged
+        {"metric": "zipf_hot_traffic_ivf_flat_500000x96",
+         "unit": "QPS", "scenario": "zipf_hot_traffic",
+         "engine": "ivf_flat", "nq": 1024, "zipf_s": 1.1,
+         "n_templates": 64, "program_qps": 1.8e5,
+         "uncached_qps": 1.5e5, "cached_qps": 3.4e5,
+         "qps_uplift": 2.27, "cache_hit_rate": 0.61,
+         "coalesce_rate": 0.07, "p99_ms_uncached": 14.9,
+         "p99_ms_cached": 9.1, "cached_identical": True,
+         "spread": 0.03, "repeats": 5, "vs_prev": 1.0},
+        # the round-17 cold-tier row under test
+        {"metric": "cold_tier_ivf_flat_500000x96", "unit": "QPS",
+         "scenario": "cold_tier", "engine": "ivf_flat", "nq": 1024,
+         "zipf_s": 1.1, "n_templates": 64, "n_slots": 512,
+         "capacity_x": 4.0, "program_qps": 1.8e5,
+         "hot_qps": 1.6e5, "tiered_qps": 1.4e5,
+         "qps_ratio_vs_hot": 0.875, "tier_hit_rate": 0.93,
+         "tier_hit_rate_50": 0.96, "tier_hit_rate_80": 0.94,
+         "tier_hit_rate_95": 0.91, "p99_ms_50": 6.1,
+         "p99_ms_80": 9.8, "p99_ms_95": 15.2,
+         "fetch_overlap_pct": 71.4, "tier_fetches": 812,
+         "recall_vs_hot": 0.982, "tier_degraded": False,
+         "spread": 0.03, "repeats": 5, "vs_prev": 1.0},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "program_audit_ms": 34193.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # on a roomy line the row prints whole, acceptance keys included
+    small = benchtop._fit_line({
+        "metric": "cold_tier_ivf_flat_500000x96", "unit": "QPS",
+        "capacity_x": 4.0, "tiered_qps": 1.4e5, "hot_qps": 1.6e5,
+        "qps_ratio_vs_hot": 0.875, "tier_hit_rate": 0.93,
+        "fetch_overlap_pct": 71.4, "recall_vs_hot": 0.982,
+        "tier_degraded": False,
+        "extras": [],
+    })
+    small_parsed = json.loads(small)
+    assert small_parsed["capacity_x"] == 4.0
+    assert small_parsed["recall_vs_hot"] == 0.982
+    assert small_parsed["tier_hit_rate"] == 0.93
+    assert small_parsed["tier_degraded"] is False
+    # the acceptance evidence is untrimmable; the secondaries trim
+    for key in ("capacity_x", "recall_vs_hot", "tier_hit_rate",
+                "tiered_qps", "qps_ratio_vs_hot", "fetch_overlap_pct",
+                "tier_hit_rate_95"):
+        assert key in benchtop._PRINT_KEYS
+        assert key not in benchtop._TRIM_ORDER
+    for key in ("n_slots", "tier_fetches", "tier_degraded",
+                "tier_hit_rate_50", "tier_hit_rate_80", "hot_qps"):
         assert key in benchtop._PRINT_KEYS
         assert key in benchtop._TRIM_ORDER
