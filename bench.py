@@ -1358,6 +1358,16 @@ _PRINT_KEYS = {
     "qps_ratio_vs_hot", "tier_hit_rate", "fetch_overlap_pct",
     "recall_vs_hot", "tier_degraded", "tier_fetches",
     "tier_hit_rate_50", "tier_hit_rate_80", "tier_hit_rate_95",
+    # the self-healing supervisor row (ISSUE 18, docs/robustness.md
+    # "Self-healing"): scripted kill→reroute→heal→reintegrate under
+    # open-loop Zipf — detection_ms / route_convergence_ms /
+    # reintegration_ms are the acceptance stamps, the per-phase p99s
+    # the degradation evidence, route_pushes/heals_ok/transitions the
+    # debounce audit (pushes == confirmed transitions, no flap storms)
+    "detection_ms", "route_convergence_ms", "reintegration_ms",
+    "p99_ms_healthy", "p99_ms_degraded", "p99_ms_healed",
+    "healed_p99_x", "route_pushes", "heals_ok", "transitions",
+    "all_serving", "rate_rps", "gen_lag_ms",
 }
 
 
@@ -1385,6 +1395,11 @@ _TRIM_ORDER = (
     # tier_hit_rate / tiered_qps / qps_ratio_vs_hot /
     # fetch_overlap_pct / tier_hit_rate_95 are acceptance evidence and
     # stay untrimmable
+    # self_heal secondaries fall first; detection_ms /
+    # route_convergence_ms / reintegration_ms / healed_p99_x /
+    # p99_ms_degraded are acceptance evidence and stay untrimmable
+    "gen_lag_ms", "rate_rps", "all_serving", "transitions",
+    "route_pushes", "heals_ok", "p99_ms_healthy", "p99_ms_healed",
     "n_slots", "tier_fetches", "tier_degraded",
     "tier_hit_rate_50", "tier_hit_rate_80", "hot_qps",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",

@@ -58,6 +58,7 @@ async pipelined dispatch), and the row reports
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
 import time
@@ -938,6 +939,271 @@ def cold_tier_row(index, qall, *, k: int, n_probes: int,
     return row
 
 
+def self_heal_row(x, qall, *, k: int = 10, n_probes: int = 16,
+                  replication: int = 2, n_lists: int = 32,
+                  request_size: int = 8, n_templates: int = 32,
+                  zipf_s: float = 1.1, kill_at_s: float = 0.6,
+                  heal_at_s: float = 2.0, duration_s: float = 4.0,
+                  max_rows: int = 65_536, consecutive: int = 2,
+                  cooldown_s: float = 0.1, seed: int = 43) -> dict:
+    """The self-healing supervisor row (ISSUE 18, docs/robustness.md
+    "Self-healing"): one scripted kill→reroute→heal→reintegrate cycle
+    against a live open-loop Zipf stream, with the SUPERVISOR doing all
+    recovery — the schedule only flips the scripted health truth (and
+    wrecks the dead rank's slabs, so the reroute is load-bearing, not
+    cosmetic). Builds its own R-way replicated MNMG index over every
+    visible device (needs >= 2; error-stamped row otherwise). Stamps:
+
+    * ``detection_ms`` — kill instant → the monitor's confirmed down
+      (the debounce cost: ``consecutive`` probes + tick cadence);
+    * ``route_convergence_ms`` — kill instant → the supervisor's route
+      push landing in the executor (acceptance: bounded, no manual
+      call in the path);
+    * ``reintegration_ms`` — heal signal → heal_done (checkpoint
+      re-splice via ``recover_rank``; the recover program is warmed
+      off the clock, so this prices the steady-state heal, not a
+      first-compile);
+    * ``p99_ms_healthy`` / ``p99_ms_degraded`` / ``p99_ms_healed`` —
+      per-request p99 split by submit stamp into the three phases, and
+      ``healed_p99_x`` (healed/healthy — the did-it-actually-recover
+      ratio).
+
+    Requests keep flowing through the whole cycle; admission is
+    unbounded here because the row prices the failover path, not
+    shedding (that is ``overload_2x``)."""
+    import os
+    import shutil
+    import tempfile
+
+    from raft_tpu.comms import (
+        build_comms, mnmg_ivf_flat_build, mnmg_ivf_flat_search,
+        place_index, recover_rank,
+    )
+    from raft_tpu.resilience import (
+        FailoverPlan, HealActions, HealthMonitor, ReplicaPlacement,
+        ServingSupervisor, ShardHealth,
+    )
+    from raft_tpu.serving import ServingExecutor
+    from raft_tpu.spatial.ann import IVFFlatParams, save_index
+    from raft_tpu.testing import chaos, load
+
+    row = {
+        "engine": "ivf_flat", "scenario": "self_heal",
+        "nq": int(request_size), "request_size": int(request_size),
+        "zipf_s": float(zipf_s), "n_templates": int(n_templates),
+        "replication": int(replication),
+    }
+    devices = jax.devices()
+    if len(devices) < 2:
+        row["error"] = "self_heal needs >= 2 devices"
+        return row
+    n_ranks = len(devices)
+    row["n_ranks"] = n_ranks
+    comms = build_comms(devices)
+    xs = np.asarray(x, np.float32)[:max_rows]
+    idx0 = mnmg_ivf_flat_build(
+        comms, xs,
+        IVFFlatParams(n_lists=n_lists, kmeans_n_iters=4,
+                      kmeans_init="random", seed=seed),
+        metric="sqeuclidean",
+    )
+    rep = place_index(comms, idx0, replication=replication)
+    tmp = tempfile.mkdtemp(prefix="raft_tpu_self_heal_")
+    ckpt = os.path.join(tmp, "base.npz")
+    try:
+        save_index(rep, ckpt)
+        cell = {"idx": rep}
+        cell_lock = threading.Lock()
+        qcap = int(request_size)
+        d = int(np.asarray(qall).shape[1])
+
+        def dispatch(batch, shard_mask=None, failover=None, **_rt):
+            with cell_lock:
+                idx = cell["idx"]
+            return mnmg_ivf_flat_search(
+                comms, idx, batch, k, n_probes=n_probes, qcap=qcap,
+                shard_mask=(shard_mask if shard_mask is not None
+                            else np.ones(n_ranks, np.int32)),
+                failover=failover,
+            )
+
+        health = ShardHealth(n_ranks)
+        placement = ReplicaPlacement.of_index(rep)
+        monitor = HealthMonitor(n_ranks, consecutive=consecutive,
+                                cooldown_s=cooldown_s,
+                                clock=time.perf_counter)
+        scripted = chaos.ScriptedHealth(n_ranks)
+        dead = n_ranks // 2
+
+        def recover(rank):
+            with cell_lock:
+                cell["idx"] = recover_rank(comms, cell["idx"], ckpt,
+                                           rank)
+
+        sup = ServingSupervisor(
+            health, placement, scripted.probe,
+            heal=HealActions(recover=recover), monitor=monitor,
+            interval_s=0.01, step_deadline_s=120.0,
+            clock=time.perf_counter, name="bench-self-heal",
+        )
+
+        # warm the serving AND recover programs off the clock, so the
+        # stamps price the steady state, not first compiles
+        plan0 = FailoverPlan.load_balanced(placement, health)
+        q_pool = np.asarray(qall, np.float32)
+        rng = np.random.default_rng(seed)
+        pool = np.stack([
+            q_pool[rng.integers(0, q_pool.shape[0], size=request_size)]
+            * (1.0 + 1e-6 * (t + 1))
+            for t in range(n_templates)
+        ])
+        jax.block_until_ready(dispatch(
+            jnp.asarray(pool[0]), shard_mask=health.mask(),
+            failover=plan0,
+        ))
+        recover_rank(comms, rep, ckpt, dead)      # discarded warm splice
+
+        service_s = _dispatch_p50_s(
+            lambda qq: dispatch(qq), jnp.asarray(pool[0]), reps=8,
+        )
+        rate_rps = max(4.0, 0.5 / max(service_s, 1e-4))
+        n_requests = int(duration_s * rate_rps) + 1
+        row["rate_rps"] = round(rate_rps, 1)
+        row["n_requests"] = n_requests
+
+        ex = ServingExecutor(
+            dispatch, (qcap,), dim=d, flush_age_s=0.0,
+            max_in_flight=2,
+            runtime_inputs={"shard_mask": health.mask(),
+                            "failover": plan0},
+        )
+        sup.register(ex)
+
+        marks = {}
+
+        def kill_fire():
+            marks["kill"] = time.perf_counter()
+            with cell_lock:
+                idx = cell["idx"]
+                cell["idx"] = dataclasses.replace(
+                    idx,
+                    vectors_sorted=jnp.asarray(idx.vectors_sorted)
+                    .at[dead].set(0),
+                    sorted_ids=jnp.asarray(idx.sorted_ids)
+                    .at[dead].set(0),
+                )
+            scripted.set(dead, False)
+
+        def heal_fire():
+            marks["heal"] = time.perf_counter()
+            scripted.set(dead, True)
+
+        csched = chaos.ChaosSchedule(scripted=scripted, seed=seed)
+        csched.at(kill_at_s, f"kill_rank_{dead}", kill_fire)
+        csched.at(heal_at_s, f"heal_rank_{dead}", heal_fire)
+
+        sched_load = load.poisson_arrivals(
+            rate_rps, n_requests, seed=seed, sizes=request_size,
+            zipf_s=zipf_s, n_templates=n_templates,
+        )
+        done = {}
+        dlock = threading.Lock()
+
+        def submit(i, size):
+            fut = ex.submit(pool[int(sched_load.template_ids[i])])
+
+            def _stamp(_f, i=i):
+                with dlock:
+                    done[i] = time.perf_counter()
+
+            fut.add_done_callback(_stamp)
+            return fut
+
+        out = {}
+
+        def drive():
+            out["res"], out["stamps"], out["lag"] = load.replay(
+                sched_load, submit, clock=time.perf_counter,
+            )
+
+        drv = threading.Thread(target=drive, daemon=True,
+                               name="self-heal-load")
+        drv.start()
+        try:
+            chaos.run_schedule(csched, duration_s=duration_s,
+                               tick=lambda t: sup.step())
+            # settle: a slow host may cross duration mid-reintegration
+            t_end = time.perf_counter() + 60.0
+            while (sup.stats().heals_ok < 1
+                   and time.perf_counter() < t_end):
+                sup.step()
+                time.sleep(0.005)
+            drv.join(timeout=120.0)
+        finally:
+            ex.close()
+            sup.close()
+
+        tl = sup.timeline()
+        t_det = next((t for t, e, r in tl
+                      if e == "confirmed_down" and r == dead), None)
+        t_conv = None
+        t_heal_done = next((t for t, e, r in tl
+                            if e == "heal_done" and r == dead), None)
+        if "kill" in marks:
+            t_conv = next((t for t, e, _ in tl
+                           if e == "route_pushed"
+                           and t >= marks["kill"]), None)
+            if t_det is not None:
+                row["detection_ms"] = round(
+                    (t_det - marks["kill"]) * 1e3, 1)
+            if t_conv is not None:
+                row["route_convergence_ms"] = round(
+                    (t_conv - marks["kill"]) * 1e3, 1)
+        if t_heal_done is not None and "heal" in marks:
+            row["reintegration_ms"] = round(
+                (t_heal_done - marks["heal"]) * 1e3, 1)
+
+        lat = {"healthy": [], "degraded": [], "healed": []}
+        stamps = out.get("stamps")
+        for i, r in enumerate(out.get("res", ())):
+            if isinstance(r, BaseException):
+                continue
+            r.result(timeout=120)
+            # result() can return before the done-callback stamped —
+            # same tiny race _drive_open_loop spins out
+            while True:
+                with dlock:
+                    t_done = done.get(i)
+                if t_done is not None:
+                    break
+                time.sleep(0.0002)
+            t_sub = float(stamps[i])
+            if "kill" not in marks or t_sub < marks["kill"]:
+                phase = "healthy"
+            elif t_heal_done is None or t_sub < t_heal_done:
+                phase = "degraded"
+            else:
+                phase = "healed"
+            lat[phase].append((t_done - t_sub) * 1e3)
+        for phase, ms in lat.items():
+            if len(ms) >= 5:
+                row[f"p99_ms_{phase}"] = round(_p99(ms), 3)
+        if len(lat["healthy"]) >= 5 and len(lat["healed"]) >= 5:
+            h = _p99(lat["healthy"])
+            if h > 0:
+                row["healed_p99_x"] = round(_p99(lat["healed"]) / h, 3)
+        st = sup.stats()
+        row["route_pushes"] = st.route_pushes
+        row["heals_ok"] = st.heals_ok
+        row["transitions"] = monitor.transition_count
+        row["all_serving"] = bool(all(
+            s == "serving" for s in st.states.values()))
+        row["gen_lag_ms"] = round(out.get("lag", 0.0) * 1e3, 3)
+        return row
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def serving_latency_rows(
     n: int = 500_000, d: int = 96, k: int = 10, n_probes: int = 16,
     n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
@@ -945,6 +1211,7 @@ def serving_latency_rows(
     chain=(4, 32), escalate: int = 2,
     hedged: bool = True, overload: bool = True, mixed: bool = True,
     open_loop: bool = True, zipf: bool = True, cold_tier: bool = True,
+    self_heal: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
@@ -1160,6 +1427,23 @@ def serving_latency_rows(
         except Exception as e:                       # noqa: BLE001
             rows.append({
                 "engine": "ivf_flat", "scenario": "cold_tier",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+
+    # the self-healing supervisor row (ISSUE 18): scripted
+    # kill→reroute→heal→reintegrate under open-loop Zipf —
+    # detection/convergence/reintegration stamps + per-phase p99
+    if self_heal and "ivf_flat" in engines:
+        try:
+            rows.append(self_heal_row(
+                np.asarray(x), np.asarray(qall), k=k,
+                n_probes=n_probes,
+                n_lists=max(4, min(32, n_lists)),
+                request_size=max(1, min(8, max(nqs))),
+            ))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "ivf_flat", "scenario": "self_heal",
                 "error": f"{type(e).__name__}: {e}"[:160],
             })
 

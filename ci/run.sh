@@ -119,6 +119,20 @@ run_tier_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_tier.py -q
 }
 
+run_chaos() {
+    # Self-healing chaos smoke (ISSUE 18, docs/robustness.md
+    # "Self-healing"): the scripted chaos-schedule harness drives the
+    # supervisor unassisted through kill → reroute → heal → oscillate
+    # with the declarative invariant checkers armed, under
+    # RAFT_TPU_LOCKCHECK=1 so the supervisor/heal/ingest lock
+    # interleavings are order-checked while the chaos runs; -x because
+    # one violated invariant poisons later asserts.
+    echo "== self-healing chaos (tests/test_chaos.py, lockcheck on) =="
+    RAFT_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_chaos.py -q -x
+}
+
 run_multihost_smoke() {
     # CPU-only 2-process host-sim smoke (ISSUE 9): the multiproc
     # rendezvous workers build the (num_procs, 2) HierarchicalComms
@@ -158,11 +172,12 @@ case "$stage" in
     x64) run_x64 ;;
     docs) run_docs ;;
     tier) run_tier_smoke ;;
+    chaos) run_chaos ;;
     multihost) run_multihost_smoke ;;
     all) run_style; run_programs; run_threads; run_install_check; \
-         run_docs; run_x64; run_tier_smoke; run_multihost_smoke; \
-         run_tests ;;
-    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|tier|multihost|all)"
+         run_docs; run_x64; run_tier_smoke; run_chaos; \
+         run_multihost_smoke; run_tests ;;
+    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|tier|chaos|multihost|all)"
        exit 2 ;;
 esac
 echo "CI: OK"

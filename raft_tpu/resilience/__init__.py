@@ -30,8 +30,18 @@ classified, testable answer (docs/robustness.md):
 * admission control: :class:`AdmissionController` — bounded queue +
   concurrency + token limiter, shedding with
   :class:`raft_tpu.errors.RaftOverloadError` instead of collapsing;
-* fault injection lives in :mod:`raft_tpu.testing.faults` so the chaos
-  suite (tests/test_resilience.py) proves each behavior on CPU in CI.
+* self-healing: :class:`ServingSupervisor` + :class:`HealActions` +
+  :class:`HealthMonitor` — the background control loop that debounces
+  raw health observations (N-consecutive + cooldown), pushes
+  load-balanced failover routes into every registered executor on a
+  confirmed down (zero-retrace), and drives the resumable
+  QUARANTINED→RESYNCING→WARMING→SERVING reintegration pipeline on a
+  confirmed heal (docs/robustness.md "Self-healing");
+* fault injection lives in :mod:`raft_tpu.testing.faults`, and the
+  scripted chaos-schedule harness (:mod:`raft_tpu.testing.chaos`)
+  proves the composed loop under timed fault scripts with declarative
+  invariant checkers, so the chaos suites (tests/test_resilience.py,
+  tests/test_chaos.py) prove each behavior on CPU in CI.
 """
 
 from raft_tpu.resilience.admission import (
@@ -50,6 +60,7 @@ from raft_tpu.resilience.degraded import (
     resolve_shard_mask,
 )
 from raft_tpu.resilience.health import (
+    HealthMonitor,
     HealthProbe,
     HealthReport,
     ShardHealth,
@@ -65,6 +76,15 @@ from raft_tpu.resilience.replica import (
     record_shard_load,
     resolve_route,
 )
+from raft_tpu.resilience.supervisor import (
+    STATE_QUARANTINED,
+    STATE_RESYNCING,
+    STATE_SERVING,
+    STATE_WARMING,
+    HealActions,
+    ServingSupervisor,
+    SupervisorStats,
+)
 
 __all__ = [
     "AdmissionController",
@@ -77,9 +97,17 @@ __all__ = [
     "PartialSearchResult",
     "resolve_shard_mask",
     "ShardHealth",
+    "HealthMonitor",
     "HealthProbe",
     "HealthReport",
     "health_check",
+    "ServingSupervisor",
+    "SupervisorStats",
+    "HealActions",
+    "STATE_SERVING",
+    "STATE_QUARANTINED",
+    "STATE_RESYNCING",
+    "STATE_WARMING",
     "FailoverPlan",
     "ReplicaPlacement",
     "resolve_route",

@@ -31,7 +31,13 @@ from raft_tpu import errors
 from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.obs import metrics as obs_metrics
 
-__all__ = ["ShardHealth", "HealthProbe", "HealthReport", "health_check"]
+__all__ = [
+    "ShardHealth",
+    "HealthMonitor",
+    "HealthProbe",
+    "HealthReport",
+    "health_check",
+]
 
 # health-transition telemetry (ISSUE 13, docs/observability.md): every
 # ACTUAL up/down flip counts (idempotent re-marks do not), and the
@@ -154,6 +160,155 @@ class ShardHealth:
             down = np.nonzero(~self._up)[0].tolist()
         return (
             f"ShardHealth(n_ranks={self.n_ranks}, "
+            f"down={down if down else 'none'})"
+        )
+
+
+class HealthMonitor:
+    """Flap suppression for raw per-rank health observations
+    (thread-safe): the ONE debounce spelling shared by the
+    :class:`~raft_tpu.resilience.supervisor.ServingSupervisor` and
+    manual health loops, with the same discipline as the SLO profile
+    trigger (``obs/capture.py``): ``consecutive`` contradicting
+    observations confirm a transition, and ``cooldown_s`` of hysteresis
+    after each confirmed flip bounds how often a rank may change state
+    no matter how hard the probe oscillates.
+
+    ``observe(rank, up)`` folds one raw observation and returns
+    ``"down"`` / ``"up"`` exactly when it CONFIRMS a transition (else
+    ``None``) — the caller acts only on that edge, so an oscillating
+    probe produces at most one action per cooldown window. The clock is
+    injectable for deterministic tests. Confirmed flips count in
+    ``health_transitions_total{rank,direction}`` (the rank-attributed
+    companion of the :class:`ShardHealth` direction-only series).
+    """
+
+    def __init__(self, n_ranks: int, *, consecutive: int = 3,
+                 cooldown_s: float = 1.0, clock=time.monotonic,
+                 telemetry: bool = True):
+        errors.expects(n_ranks >= 1,
+                       "HealthMonitor: n_ranks=%d < 1", n_ranks)
+        errors.expects(consecutive >= 1,
+                       "HealthMonitor: consecutive=%d < 1", consecutive)
+        self.consecutive = int(consecutive)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._telemetry = bool(telemetry)
+        self._lock = lockcheck.make_lock("HealthMonitor._lock")
+        self._confirmed = np.ones(n_ranks, dtype=bool)
+        self._streak = np.zeros(n_ranks, dtype=np.int64)
+        # last confirmed flip per rank; -inf so the first transition is
+        # never cooldown-suppressed
+        self._last_flip = np.full(n_ranks, -np.inf, dtype=np.float64)
+        self._transitions = 0
+        self._counters: Dict[Tuple[int, str], object] = {}
+
+    @property
+    def n_ranks(self) -> int:
+        # immutable array metadata, see ShardHealth.n_ranks
+        return self._confirmed.shape[0]  # jaxlint: disable=unguarded-shared-state
+
+    def _check_rank(self, rank: int) -> None:
+        errors.expects(   # .shape reads: immutable metadata
+            0 <= rank < self.n_ranks,
+            "HealthMonitor: rank %d out of range [0, %d)",
+            rank, self.n_ranks,
+        )
+
+    def _count_flip(self, rank: int, direction: str) -> None:
+        key = (rank, direction)
+        c = self._counters.get(key)
+        if c is None:
+            reg = obs_metrics.default_registry()
+            c = reg.counter("health_transitions_total",
+                            rank=rank, direction=direction)
+            self._counters[key] = c
+        c.inc()
+
+    def observe(self, rank: int, up: bool) -> Optional[str]:
+        """Fold one raw observation; return ``"down"``/``"up"`` iff it
+        confirms a transition, else ``None``.
+
+        A transition confirms when ``consecutive`` back-to-back
+        observations contradict the confirmed state AND ``cooldown_s``
+        has elapsed since that rank's last confirmed flip. A
+        cooldown-suppressed streak is KEPT (not reset), so a contradiction
+        that persists through the window flips on the first observation
+        after it expires."""
+        self._check_rank(rank)
+        up = bool(up)
+        with self._lock:
+            if up == bool(self._confirmed[rank]):
+                self._streak[rank] = 0
+                return None
+            self._streak[rank] += 1
+            if self._streak[rank] < self.consecutive:
+                return None
+            now = float(self._clock())
+            if now - float(self._last_flip[rank]) < self.cooldown_s:
+                return None  # hysteresis: streak kept, flip deferred
+            self._confirmed[rank] = up
+            self._streak[rank] = 0
+            self._last_flip[rank] = now
+            self._transitions += 1
+            direction = "up" if up else "down"
+            if self._telemetry:
+                # counter write inside the lock, same rationale as
+                # ShardHealth.mark_down (flip-ordered counts)
+                self._count_flip(rank, direction)
+        return direction
+
+    def observe_report(self, report: "HealthReport") -> Dict[int, str]:
+        """Fold a :class:`HealthReport` sweep as DOWN observations for
+        every implicated rank, mirroring ``ShardHealth.apply_report``
+        (failed attributed probes down their ranks; an unattributed
+        failure implicates every rank; passing probes observe nothing —
+        up-observations need a positive per-rank signal via
+        :meth:`observe`). Returns ``{rank: direction}`` for the
+        transitions this sweep confirmed."""
+        implicated: set = set()
+        for probe in report.probes.values():
+            if probe.ok:
+                continue
+            implicated.update(probe.ranks or range(self.n_ranks))
+        out: Dict[int, str] = {}
+        for r in sorted(implicated):
+            d = self.observe(r, False)
+            if d is not None:
+                out[r] = d
+        return out
+
+    def is_up(self, rank: int) -> bool:
+        """The CONFIRMED (debounced) state of ``rank``."""
+        self._check_rank(rank)
+        with self._lock:
+            return bool(self._confirmed[rank])
+
+    def force(self, rank: int, up: bool) -> None:
+        """Pin the confirmed state WITHOUT counting a transition — the
+        supervisor's rollback hook: after a failed heal it forces the
+        rank back to confirmed-down so only a fresh sustained up-streak
+        (post-cooldown) re-triggers reintegration."""
+        self._check_rank(rank)
+        with self._lock:
+            self._confirmed[rank] = bool(up)
+            self._streak[rank] = 0
+            self._last_flip[rank] = float(self._clock())
+
+    @property
+    def transition_count(self) -> int:
+        """Total confirmed transitions — the flap-invariant bound
+        (route pushes per supervisor must never exceed it)."""
+        with self._lock:
+            return int(self._transitions)
+
+    def __repr__(self) -> str:
+        with self._lock:
+            down = np.nonzero(~self._confirmed)[0].tolist()
+        return (
+            f"HealthMonitor(n_ranks={self.n_ranks}, "
+            f"consecutive={self.consecutive}, "
+            f"cooldown_s={self.cooldown_s}, "
             f"down={down if down else 'none'})"
         )
 

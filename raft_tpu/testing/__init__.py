@@ -1,13 +1,16 @@
 """Testing utilities shipped with the library — deterministic fault
 injection (:mod:`raft_tpu.testing.faults`) for exercising the resilience
-layer (``raft_tpu.resilience``) without hardware faults, and the seeded
+layer (``raft_tpu.resilience``) without hardware faults, the seeded
 open-loop load generator (:mod:`raft_tpu.testing.load`) that drives the
 serving executor (``raft_tpu.serving``) with replayable Poisson arrival
-streams. The reference ships its comms self-tests as library code for
-the same reason: failure handling that is only testable in production
-is not testable.
+streams, and the scripted chaos-schedule harness
+(:mod:`raft_tpu.testing.chaos`) that composes the injectors into timed
+fault scripts with declarative invariant checkers — the proof engine
+for the self-healing supervisor. The reference ships its comms
+self-tests as library code for the same reason: failure handling that
+is only testable in production is not testable.
 """
 
-from raft_tpu.testing import faults, load
+from raft_tpu.testing import chaos, faults, load
 
-__all__ = ["faults", "load"]
+__all__ = ["chaos", "faults", "load"]

@@ -57,10 +57,13 @@ class SlabFetcher:
                  policy=None,
                  busy_fn: Optional[Callable[[], bool]] = None,
                  name: Optional[str] = None,
+                 max_restarts: int = 3,
                  clock: Callable[[], float] = time.monotonic):
         from raft_tpu import errors
 
         errors.expects(window >= 1, "SlabFetcher: window=%d < 1", window)
+        errors.expects(max_restarts >= 0,
+                       "SlabFetcher: max_restarts=%d < 0", max_restarts)
         self.store = store
         self.window = int(window)
         self.max_pending = (4 * store.n_slots if max_pending is None
@@ -68,6 +71,7 @@ class SlabFetcher:
         self.policy = policy
         self._busy_fn = busy_fn
         self.name = name or f"{store.name}-fetch"
+        self.max_restarts = int(max_restarts)
         self._clock = clock
         self._lock = lockcheck.make_lock("SlabFetcher._lock")
         self._work = lockcheck.make_condition(self._lock)
@@ -76,13 +80,17 @@ class SlabFetcher:
         self._closed = False
         self._drops = 0
         self._cycles = 0
+        self._restarts = 0
+        self._gave_up = False
         reg = obs_metrics.default_registry()
         self._c_dropped = reg.counter("tier_fill_dropped_total",
                                       tier=store.name)
+        self._c_restarts = reg.counter("tier_fetcher_restarts_total",
+                                       tier=store.name)
         obs_crash.install_excepthook()
         store.attach_fill_sink(self.request)
         self._thread = threading.Thread(
-            target=self._loop, name=self.name, daemon=True,
+            target=self._run, name=self.name, daemon=True,
         )
         self._thread.start()
 
@@ -118,7 +126,17 @@ class SlabFetcher:
     def stats(self) -> dict:
         with self._lock:
             return {"pending": len(self._queue), "dropped": self._drops,
-                    "cycles": self._cycles}
+                    "cycles": self._cycles, "restarts": self._restarts,
+                    "gave_up": self._gave_up}
+
+    @property
+    def gave_up(self) -> bool:
+        """True once the bounded restart policy exhausted: the worker
+        is dead, the fill sink is detached, and the store serves from
+        its current hot set (degraded, recall-guardrail-watched) until
+        a replacement fetcher is attached."""
+        with self._lock:
+            return self._gave_up
 
     def drain(self, timeout: float = 10.0) -> bool:
         """Block until the queue is empty and the in-cycle batch has
@@ -147,6 +165,44 @@ class SlabFetcher:
         self.close()
 
     # -- the fetcher thread ----------------------------------------------
+    def _run(self) -> None:
+        """The thread target: ``_loop`` under a BOUNDED restart policy
+        (ISSUE 18). A promotion-batch exception used to kill the worker
+        silently — the queue kept filling, nothing drained, and the
+        first symptom was recall decay. Now each crash counts in
+        ``tier_fetcher_restarts_total{tier=...}`` and the loop restarts
+        (per-batch bookkeeping in ``_loop``'s ``finally`` keeps the
+        queue consistent across the tear-down); after ``max_restarts``
+        crashes the worker GIVES UP deliberately: detach the fill sink
+        (the store serves from its current hot set — degraded but
+        correct, the recall guardrail watches it), record a flight
+        event, and re-raise so the crash excepthook chain
+        (``obs/crash.py``, installed in ``__init__``) surfaces the
+        final exception in ``thread_uncaught_total``."""
+        while True:
+            try:
+                self._loop()
+                return                      # clean close()
+            except Exception:
+                with self._lock:
+                    self._restarts += 1
+                    restarts = self._restarts
+                    give_up = restarts > self.max_restarts
+                    if give_up:
+                        self._gave_up = True
+                if not give_up:
+                    self._c_restarts.inc()
+                    continue
+                # exhausted: degrade to serve-from-hot and surface
+                self.store.attach_fill_sink(None)
+                if getattr(self.store, "flight", None) is not None:
+                    self.store.flight.record(
+                        "tier_fetcher_gave_up", tier=self.store.name,
+                        restarts=restarts,
+                        max_restarts=self.max_restarts,
+                    )
+                raise
+
     def _loop(self) -> None:
         while True:
             with self._work:

@@ -1,0 +1,883 @@
+"""ISSUE 18 chaos suite: the self-healing supervisor proven by the
+scripted chaos-schedule harness (raft_tpu/resilience/supervisor.py +
+raft_tpu/testing/chaos.py) — no manual recovery calls anywhere:
+
+* HealthMonitor debounce: N-consecutive confirm, cooldown hysteresis
+  (injectable clock), kept streaks across a suppressed window, report
+  folding, force() re-arm;
+* flap invariant: an oscillating probe produces ZERO route pushes, and
+  a confirmed transition exactly one (deterministic, fake clock);
+* the resumable heal pipeline: per-step retry under RetryPolicy,
+  partial-failure rollback back to QUARANTINED (monitor re-armed), and
+  resume-from-cursor after a mid-heal supervisor crash;
+* supervisor thread crash surfaced via thread_uncaught_total and
+  restartable with start() (state, incl. heal progress, survives);
+* the chaos-schedule engine itself (replay-order firing, fake-clock
+  determinism, convergence checker deadlines);
+* resync_rank racing live acked ingest loses no acked write, with the
+  SUPERVISOR driving recover→resync (the write-exclusion edge lives in
+  the heal action: health flips up inside resync's critical section);
+* the acceptance schedule — rank kill mid-ingest → straggler burst →
+  heal → oscillating probe — against a live open-loop executor, with
+  coverage==1.0 / bit-identity / zero-acked-writes-lost /
+  zero-retrace / bounded-route-convergence / no-flap all asserted by
+  the declarative checker framework.
+
+Runs in tier-1 on the virtual 8-device CPU mesh and again under
+RAFT_TPU_LOCKCHECK=1 in the `ci/run.sh chaos` stage.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.comms import (
+    build_comms,
+    mnmg_ivf_flat_build,
+    mnmg_mutable_search,
+    mnmg_upsert,
+    place_index,
+    recover_rank,
+    resync_rank,
+    wrap_mnmg_mutable,
+)
+from raft_tpu.obs import FlightRecorder
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.resilience import (
+    STATE_QUARANTINED,
+    STATE_SERVING,
+    FailoverPlan,
+    HealActions,
+    HealthMonitor,
+    ReplicaPlacement,
+    RetryPolicy,
+    ServingSupervisor,
+    ShardHealth,
+)
+from raft_tpu.resilience.health import HealthProbe, HealthReport
+from raft_tpu.serving import ServingExecutor
+from raft_tpu.spatial.ann import IVFFlatParams, save_index
+from raft_tpu.testing import chaos
+
+K = 5
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+class _RecordingExecutor:
+    """set_runtime sink — counts the supervisor's route pushes."""
+
+    def __init__(self):
+        self.pushes = []
+
+    def set_runtime(self, **updates):
+        self.pushes.append(updates)
+
+
+# ---------------------------------------------------------------------------
+# HealthMonitor debounce (no mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthMonitor:
+    def test_consecutive_confirm_and_streak_reset(self):
+        m = HealthMonitor(4, consecutive=3, cooldown_s=0.0,
+                          telemetry=False)
+        assert m.observe(1, False) is None
+        assert m.observe(1, False) is None
+        # a contradiction broken by an agreeing observation resets
+        assert m.observe(1, True) is None
+        assert m.observe(1, False) is None
+        assert m.observe(1, False) is None
+        assert m.observe(1, False) == "down"
+        assert not m.is_up(1) and m.is_up(0)
+        assert m.transition_count == 1
+
+    def test_cooldown_suppresses_then_defers_not_drops(self):
+        clk = _FakeClock()
+        m = HealthMonitor(2, consecutive=2, cooldown_s=1.0, clock=clk,
+                          telemetry=False)
+        assert m.observe(0, False) is None
+        assert m.observe(0, False) == "down"
+        # immediate recovery streak: confirmed but inside cooldown
+        assert m.observe(0, True) is None
+        assert m.observe(0, True) is None      # streak=2, suppressed
+        assert m.observe(0, True) is None
+        clk.advance(1.01)
+        # streak was KEPT: first post-cooldown observation flips
+        assert m.observe(0, True) == "up"
+        assert m.transition_count == 2
+
+    def test_oscillation_never_confirms(self):
+        m = HealthMonitor(2, consecutive=2, cooldown_s=0.0,
+                          telemetry=False)
+        for i in range(40):
+            assert m.observe(1, i % 2 == 0) is None
+        assert m.is_up(1) and m.transition_count == 0
+
+    def test_force_rearms_without_counting(self):
+        clk = _FakeClock()
+        m = HealthMonitor(2, consecutive=1, cooldown_s=0.5, clock=clk,
+                          telemetry=False)
+        assert m.observe(0, False) == "down"
+        clk.advance(1.0)
+        assert m.observe(0, True) == "up"
+        m.force(0, up=False)                   # rollback re-arm
+        assert not m.is_up(0)
+        assert m.transition_count == 2          # force did not count
+        # cooldown restarts at force time: an immediate up is deferred
+        assert m.observe(0, True) is None
+        clk.advance(0.51)
+        assert m.observe(0, True) == "up"
+
+    def test_observe_report_downs_implicated_ranks_only(self):
+        m = HealthMonitor(4, consecutive=1, cooldown_s=0.0,
+                          telemetry=False)
+        rep = HealthReport(probes={
+            "allreduce": HealthProbe(ok=True, seconds=0.01),
+            "heartbeat": HealthProbe(ok=False, seconds=0.01, ranks=(2,)),
+        })
+        assert m.observe_report(rep) == {2: "down"}
+        assert m.is_up(0) and not m.is_up(2)
+        # unattributed failure implicates everyone
+        rep2 = HealthReport(probes={
+            "allreduce": HealthProbe(ok=False, seconds=0.01),
+        })
+        out = m.observe_report(rep2)
+        assert set(out) == {0, 1, 3} and all(v == "down"
+                                             for v in out.values())
+
+
+# ---------------------------------------------------------------------------
+# Supervisor state machine (no mesh — fake executors, fake clock)
+# ---------------------------------------------------------------------------
+
+
+def _mini_supervisor(clk, scripted, *, n=8, consecutive=3,
+                     cooldown_s=10.0, heal=None, retry=None):
+    health = ShardHealth(n, telemetry=False)
+    monitor = HealthMonitor(n, consecutive=consecutive,
+                            cooldown_s=cooldown_s, clock=clk,
+                            telemetry=False)
+    sup = ServingSupervisor(
+        health, ReplicaPlacement.striped(n, 2), scripted.probe,
+        heal=heal, monitor=monitor, retry=retry,
+        clock=clk, sleep=clk.advance,
+    )
+    return sup, health, monitor
+
+
+class TestSupervisorFlap:
+    def test_oscillation_never_pushes_confirmed_pushes_once(self):
+        """ISSUE 18 satellite: oscillating health reports never produce
+        more than one route push per CONFIRMED transition — and an
+        oscillation that never confirms produces none at all."""
+        clk = _FakeClock()
+        scripted = chaos.ScriptedHealth(8)
+        sup, health, monitor = _mini_supervisor(clk, scripted)
+        ex = _RecordingExecutor()
+        sup.register(ex)
+        base = len(ex.pushes)                   # the register sync push
+        # a probe oscillating every tick: streak never reaches 3
+        for i in range(30):
+            scripted.set(2, i % 2 == 0)
+            sup.step()
+            clk.advance(0.05)
+        assert len(ex.pushes) == base
+        assert monitor.transition_count == 0 and health.is_up(2)
+        # sustained death: exactly ONE push, on the confirming tick
+        scripted.set(2, False)
+        for _ in range(5):
+            sup.step()
+            clk.advance(0.05)
+        assert monitor.transition_count == 1
+        assert len(ex.pushes) == base + 1
+        assert sup.state(2) == STATE_QUARANTINED and not health.is_up(2)
+        # the pushed mask/plan routes around rank 2, coverage intact
+        push = ex.pushes[-1]
+        assert push["shard_mask"][2] == 0
+        assert push["failover"].fully_covered
+        # more oscillation inside the cooldown: still nothing
+        for i in range(30):
+            scripted.set(2, i % 2 == 0)
+            sup.step()
+            clk.advance(0.05)
+        assert len(ex.pushes) == base + 1
+        # sustained recovery past the cooldown: one heal, one push
+        clk.advance(11.0)
+        scripted.set(2, True)
+        for _ in range(5):
+            sup.step()
+            clk.advance(0.05)
+        assert monitor.transition_count == 2
+        assert len(ex.pushes) == base + 2
+        assert sup.state(2) == STATE_SERVING and health.is_up(2)
+        # the flap invariant, as the checker spells it
+        flap = chaos.BoundInvariant(
+            "no-route-flap",
+            lambda: (len(ex.pushes) - base) - monitor.transition_count,
+            0,
+        )
+        flap.sample(clk.t)
+        assert not flap.violations
+
+
+class TestSupervisorHeal:
+    def test_retry_backoff_then_success(self):
+        clk = _FakeClock()
+        scripted = chaos.ScriptedHealth(4)
+        calls = {"resync": 0}
+
+        def flaky_resync(rank):
+            calls["resync"] += 1
+            if calls["resync"] < 3:
+                raise errors.RaftTimeoutError("transient splice timeout")
+
+        sup, health, monitor = _mini_supervisor(
+            clk, scripted, n=4, consecutive=1, cooldown_s=0.0,
+            heal=HealActions(resync=flaky_resync),
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.01),
+        )
+        scripted.set(1, False)
+        sup.step()
+        assert sup.state(1) == STATE_QUARANTINED
+        scripted.set(1, True)
+        sup.step()
+        assert calls["resync"] == 3             # two retries then success
+        assert sup.state(1) == STATE_SERVING and health.is_up(1)
+        assert sup.stats().heals_ok == 1
+        assert sup.stats().heals_rolled_back == 0
+
+    def test_nonretryable_failure_rolls_back_and_rearms(self):
+        clk = _FakeClock()
+        scripted = chaos.ScriptedHealth(4)
+        calls = {"recover": 0, "rollback": 0, "broken": True}
+
+        def recover(rank):
+            calls["recover"] += 1
+            if calls["broken"]:
+                raise errors.CorruptIndexError("torn checkpoint")
+
+        def rollback(rank):
+            calls["rollback"] += 1
+
+        sup, health, monitor = _mini_supervisor(
+            clk, scripted, n=4, consecutive=1, cooldown_s=0.0,
+            heal=HealActions(recover=recover, rollback=rollback),
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.01),
+        )
+        ex = _RecordingExecutor()
+        sup.register(ex)
+        base = len(ex.pushes)
+        scripted.set(2, False)
+        sup.step()
+        assert len(ex.pushes) == base + 1
+        scripted.set(2, True)
+        sup.step()
+        # CorruptIndexError is not retryable: ONE attempt, rollback,
+        # back to QUARANTINED, the routed-around plan keeps serving —
+        # and NO route push for the failed heal
+        assert calls["recover"] == 1 and calls["rollback"] == 1
+        assert sup.state(2) == STATE_QUARANTINED and not health.is_up(2)
+        assert sup.stats().heals_rolled_back == 1
+        assert len(ex.pushes) == base + 1
+        # monitor was re-armed to confirmed-down: the still-up probe
+        # re-confirms on the next tick and the (now fixed) heal runs
+        calls["broken"] = False
+        sup.step()
+        assert sup.state(2) == STATE_SERVING and health.is_up(2)
+        assert sup.stats().heals_ok == 1
+        assert len(ex.pushes) == base + 2
+
+    def test_mid_heal_crash_resumes_from_cursor(self):
+        """The pipeline is RESUMABLE: a supervisor crash between steps
+        (anything that unwinds step() — here a BaseException from the
+        resync actuator) leaves the per-rank cursor on the object, and
+        the next step() resumes AFTER the completed recover step
+        instead of replaying the side-effectful splice."""
+
+        class _Crash(BaseException):
+            pass
+
+        clk = _FakeClock()
+        scripted = chaos.ScriptedHealth(4)
+        calls = {"recover": 0, "resync": 0, "crash": True}
+
+        def recover(rank):
+            calls["recover"] += 1
+
+        def resync(rank):
+            calls["resync"] += 1
+            if calls["crash"]:
+                calls["crash"] = False
+                raise _Crash()
+
+        sup, health, monitor = _mini_supervisor(
+            clk, scripted, n=4, consecutive=1, cooldown_s=0.0,
+            heal=HealActions(recover=recover, resync=resync),
+        )
+        scripted.set(3, False)
+        sup.step()
+        scripted.set(3, True)
+        with pytest.raises(_Crash):
+            sup.step()                          # dies mid-pipeline
+        assert calls["recover"] == 1 and calls["resync"] == 1
+        assert sup.state(3) != STATE_SERVING
+        sup.step()                              # "restart": resumes
+        assert calls["recover"] == 1            # NOT replayed
+        assert calls["resync"] == 2
+        assert sup.state(3) == STATE_SERVING and health.is_up(3)
+
+    # the injected crash IS the point — silence pytest's
+    # unhandled-thread-exception warning for it
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_thread_crash_surfaced_and_restartable(self):
+        """ISSUE 18 satellite: a supervisor thread crash is caught by
+        the crash excepthook chain (thread_uncaught_total names the
+        thread), and start() simply restarts the loop from the
+        object's state."""
+        prev_obs = obs_metrics.set_enabled(True)
+        try:
+            boom = {"on": False}
+
+            def probe():
+                if boom["on"]:
+                    raise RuntimeError("injected supervisor crash")
+                return {r: True for r in range(4)}
+
+            sup = ServingSupervisor(
+                ShardHealth(4, telemetry=False),
+                ReplicaPlacement.striped(4, 2), probe,
+                interval_s=0.003, name="chaos18-sup-crash",
+            )
+            sup.start()
+            deadline = time.monotonic() + 10
+            while sup.stats().ticks < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert sup.stats().ticks >= 2
+            boom["on"] = True
+            while sup._thread.is_alive() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert not sup._thread.is_alive()
+            snap = obs_metrics.default_registry().snapshot()
+            assert any(
+                row["labels"].get("thread") == "chaos18-sup-crash"
+                for row in snap.get("thread_uncaught_total", [])
+            ), "the crash must surface in thread_uncaught_total"
+            # restart: same object, fresh thread, loop resumes
+            boom["on"] = False
+            ticks0 = sup.stats().ticks
+            sup.start()
+            while (sup.stats().ticks <= ticks0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert sup.stats().ticks > ticks0
+            sup.close()
+        finally:
+            obs_metrics.set_enabled(prev_obs)
+
+
+# ---------------------------------------------------------------------------
+# The schedule engine (no mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosEngine:
+    def test_events_fire_in_order_fake_clock(self):
+        clk = _FakeClock()
+        fired = []
+        sched = (
+            chaos.ChaosSchedule(seed=1)
+            .at(0.03, "b", lambda: fired.append("b"))
+            .at(0.01, "a", lambda: fired.append("a"))
+        )
+        inv = chaos.BoundInvariant("at-most-two", lambda: len(fired), 2)
+        report = chaos.run_schedule(
+            sched, duration_s=0.05, invariants=[inv],
+            check_interval_s=0.005, clock=clk, sleep=clk.advance,
+        )
+        assert report.ok, report.summary()
+        assert [n for _, n in report.fired] == ["a", "b"]
+        assert fired == ["a", "b"]
+
+    def test_oscillate_composer_ends_up(self):
+        clk = _FakeClock()
+        scripted = chaos.ScriptedHealth(4)
+        seen = []
+        sched = chaos.ChaosSchedule(scripted=scripted, seed=0)
+        sched.oscillate(0.01, 2, period_s=0.01, duration_s=0.04)
+        chaos.run_schedule(
+            sched, duration_s=0.08,
+            tick=lambda t: seen.append(scripted.probe()[2]),
+            check_interval_s=0.002, clock=clk, sleep=clk.advance,
+        )
+        assert False in seen and True in seen   # it really flapped
+        assert scripted.probe()[2] is True      # and ended up
+
+    def test_convergence_invariant_deadline(self):
+        trig = [0]
+        done = [0]
+        inv = chaos.ConvergenceInvariant("conv", lambda: trig[0],
+                                         lambda: done[0], 0.5)
+        inv.sample(0.0)
+        trig[0] = 1
+        inv.sample(0.1)                         # trigger seen at 0.1
+        inv.sample(0.5)                         # within deadline
+        assert not inv.violations
+        inv.sample(0.7)                         # 0.6 s > 0.5 s late
+        assert len(inv.violations) == 1
+        trig[0] = 2
+        inv.sample(0.8)
+        done[0] = 2                             # answered in time
+        inv.sample(0.9)
+        inv.finish(1.0)
+        assert len(inv.violations) == 1
+
+    def test_final_invariant_only_checks_at_finish(self):
+        state = {"ok": False}
+        inv = chaos.FinalInvariant("final", lambda: state["ok"])
+        inv.sample(0.1)
+        assert not inv.violations
+        state["ok"] = True
+        inv.finish(0.2)
+        assert not inv.violations
+
+    def test_straggler_gate_toggles(self):
+        calls = []
+
+        def fn(x):
+            calls.append(x)
+            return x
+
+        gate = chaos.StragglerGate(fn, every=1, seconds=0.0)
+        assert gate(1) == 1
+        gate.enable()
+        gate(2)
+        gate.disable()
+        assert gate(3) == 3
+        assert gate.audit.calls >= 1            # the window was audited
+
+    def test_inject_worker_crash_arms_and_restores(self):
+        class _Store:
+            def __init__(self):
+                self.applied = []
+
+            def apply_moves(self, moves, **kw):
+                self.applied.append(moves)
+
+        store = _Store()
+        restore = chaos.inject_worker_crash(store, times=2)
+        with pytest.raises(RuntimeError, match="injected fetcher"):
+            store.apply_moves([(1, None)])
+        with pytest.raises(RuntimeError):
+            store.apply_moves([(2, None)])
+        store.apply_moves([(3, None)])          # fault exhausted
+        assert store.applied == [[(3, None)]]
+        restore()
+        assert store.apply_moves.__self__ is store  # original bound back
+
+
+# ---------------------------------------------------------------------------
+# MNMG: supervisor-driven heal on the live mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def comms8():
+    return build_comms(jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((512, 16)).astype(np.float32)
+    q = rng.standard_normal((12, 16)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def replicated_r2(comms8, dataset):
+    x, _ = dataset
+    idx = mnmg_ivf_flat_build(
+        comms8, x,
+        IVFFlatParams(n_lists=8, kmeans_n_iters=3,
+                      kmeans_init="random", seed=2),
+        metric="sqeuclidean",
+    )
+    return place_index(comms8, idx, replication=2)
+
+
+def _heal_actions(comms, cell, lock, health, ckpt):
+    """The real reintegration actuators over a shared mutable-index
+    cell. The WRITE-EXCLUSION EDGE: ``resync`` flips health up INSIDE
+    its critical section, after the swapped-in state already carries
+    the donor's delta — so a writer that snapshots ``health.mask()``
+    under the same lock can never ack a write that misses the healed
+    copy (the resync-vs-live-ingest race)."""
+
+    def recover(rank):
+        with lock:
+            mw = cell["mw"]
+            rec = recover_rank(comms, mw.index, ckpt, rank)
+            mw2 = dataclasses.replace(mw, index=rec)
+            mw2._id_loc = None
+            cell["mw"] = mw2
+
+    def resync(rank):
+        with lock:
+            cell["mw"] = resync_rank(comms, cell["mw"], rank)
+            health.mark_up(rank)
+
+    return HealActions(recover=recover, resync=resync)
+
+
+def test_resync_racing_live_ingest_supervisor_driven(
+    comms8, dataset, replicated_r2, tmp_path
+):
+    """ISSUE 18 satellite: resync_rank racing live acked upsert traffic
+    loses no acked write — and unlike the hand-scripted ISSUE 7 test,
+    the SUPERVISOR drives the whole recover→resync pipeline while a
+    background writer keeps acking with ``alive=health.mask()``."""
+    x, _ = dataset
+    ckpt = tmp_path / "base.npz"
+    save_index(replicated_r2, ckpt)
+    cell = {"mw": wrap_mnmg_mutable(comms8, replicated_r2, delta_cap=64)}
+    lock = threading.Lock()
+    health = ShardHealth(8, telemetry=False)
+    scripted = chaos.ScriptedHealth(8)
+    sup = ServingSupervisor(
+        health, ReplicaPlacement.of_index(replicated_r2),
+        scripted.probe,
+        heal=_heal_actions(comms8, cell, lock, health, ckpt),
+        monitor=HealthMonitor(8, consecutive=1, cooldown_s=0.0,
+                              telemetry=False),
+        step_deadline_s=120.0, name="chaos18-race",
+    )
+    dead = 2
+    far = (30.0 * x[:160]).astype(np.float32)
+    acked = []
+    stop = threading.Event()
+
+    def ingest():
+        for i in range(40):
+            if stop.is_set():
+                break
+            ids = np.arange(21000 + 4 * i, 21004 + 4 * i, dtype=np.int64)
+            ids = ids.astype(np.int32)
+            with lock:
+                mw2, acc = mnmg_upsert(
+                    comms8, cell["mw"], far[4 * i:4 * i + 4], ids,
+                    alive=health.mask(),
+                )
+                cell["mw"] = mw2
+            acked.extend(int(v) for v in ids[np.asarray(acc)])
+            time.sleep(0.002)
+
+    writer = threading.Thread(target=ingest, daemon=True)
+    writer.start()
+
+    def settle(rank, state, timeout=180.0):
+        deadline = time.monotonic() + timeout
+        while sup.state(rank) != state and time.monotonic() < deadline:
+            sup.step()
+            time.sleep(0.002)
+        assert sup.state(rank) == state
+
+    sup.step()                                  # healthy baseline tick
+    scripted.set(dead, False)                   # kill mid-ingest
+    settle(dead, STATE_QUARANTINED)
+    time.sleep(0.05)                            # degraded-acked writes
+    scripted.set(dead, True)                    # heal mid-ingest
+    settle(dead, STATE_SERVING)                 # recover+resync race
+    writer.join(timeout=60)
+    stop.set()
+    assert not writer.is_alive()
+    assert len(acked) >= 8, "the run must actually ack writes"
+    assert health.all_up and sup.stats().heals_ok == 1
+
+    # EVERY acked write serves from the healthy mesh, coverage 1.0 —
+    # each upserted vector is its own query (distance 0 → top-1)
+    with lock:
+        mw = cell["mw"]
+    ids_arr = np.array(sorted(set(acked)), dtype=np.int64)
+    rows = far[ids_arr - 21000]
+    for s in range(0, len(ids_arr), 12):
+        chunk, idc = rows[s:s + 12], ids_arr[s:s + 12]
+        pad = np.zeros((12 - chunk.shape[0], chunk.shape[1]), np.float32)
+        res = mnmg_mutable_search(
+            comms8, mw, np.concatenate([chunk, pad], axis=0), K,
+            n_probes=8, qcap=12, shard_mask=health.mask(),
+        )
+        assert float(np.asarray(res.coverage).min()) == 1.0
+        np.testing.assert_array_equal(
+            np.asarray(res.ids)[:chunk.shape[0], 0], idc
+        )
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_scripted_chaos_schedule_end_to_end(
+    comms8, dataset, replicated_r2, tmp_path, monkeypatch
+):
+    """ISSUE 18 acceptance: the scripted schedule — rank kill
+    mid-ingest → straggler burst → heal → oscillating probe — runs
+    end-to-end against ONE live open-loop executor with NO manual
+    recovery calls: the supervisor detects through the debounced
+    monitor, converges the route within its deadline, drives
+    recover→resync→reintegrate itself, and every invariant is asserted
+    by the checker framework: coverage 1.0 and bit-identity vs the
+    healthy mesh whenever the control loop has converged on the
+    scripted truth (the detection window is bounded by the convergence
+    checker — no system can be correct about a failure it has not yet
+    been allowed to detect), zero acked writes lost, zero retraces
+    (cache-size audited), route pushes never exceed confirmed
+    transitions, and every rank is back to SERVING at drain."""
+    from raft_tpu.comms import mnmg_ivf_flat as mod
+
+    x, q = dataset
+    qcap = q.shape[0]
+    ckpt = tmp_path / "base.npz"
+    save_index(replicated_r2, ckpt)
+    cell = {"mw": wrap_mnmg_mutable(comms8, replicated_r2, delta_cap=64)}
+    lock = threading.Lock()
+    health = ShardHealth(8, telemetry=False)
+    placement = ReplicaPlacement.of_index(replicated_r2)
+    monitor = HealthMonitor(8, consecutive=2, cooldown_s=0.25,
+                            telemetry=False)
+    scripted = chaos.ScriptedHealth(8)
+
+    created = []
+    orig = mod._cached_search
+
+    def recording(*a, **kw):
+        fn = orig(*a, **kw)
+        created.append(fn)
+        return fn
+
+    monkeypatch.setattr(mod, "_cached_search", recording)
+
+    def run(qq, shard_mask=None, failover=None):
+        with lock:
+            mw = cell["mw"]
+        return mnmg_mutable_search(
+            comms8, mw, qq, K, n_probes=8, qcap=qcap,
+            shard_mask=(shard_mask if shard_mask is not None
+                        else np.ones(8, np.int32)),
+            failover=failover,
+        )
+
+    # healthy reference + warm both bucket shapes BEFORE the audit
+    # mark; ingest vectors are pushed 30x out of the data cloud, so
+    # the reference answer for q never changes as ingest proceeds
+    plan0 = FailoverPlan.load_balanced(placement, health)
+    ref = run(jnp.asarray(q), shard_mask=health.mask(), failover=plan0)
+    iref, vref = np.asarray(ref.ids), np.asarray(ref.distances)
+    for b in (4, qcap):
+        jax.block_until_ready(run(
+            jnp.zeros((b, q.shape[1]), jnp.float32),
+            shard_mask=health.mask(), failover=plan0,
+        ))
+    fn = created[0]
+    size0 = fn._cache_size()
+
+    gate = chaos.StragglerGate(run, every=2, seconds=0.02)
+    recorder = FlightRecorder(2048, name="chaos18")
+    ex = ServingExecutor(
+        gate, (4, qcap), dim=q.shape[1], flush_age_s=0.0,
+        max_in_flight=2,
+        runtime_inputs={"shard_mask": health.mask(), "failover": plan0},
+        flight=recorder,
+    )
+    sup = ServingSupervisor(
+        health, placement, scripted.probe,
+        heal=_heal_actions(comms8, cell, lock, health, ckpt),
+        monitor=monitor, interval_s=0.004, step_deadline_s=120.0,
+        flight=recorder, name="chaos18-e2e",
+    )
+    sup.register(ex)
+    pushes0 = sup.stats().route_pushes          # the register sync push
+
+    dead = 3
+
+    def wreck():
+        # the dead rank's slab content is LOST at the kill instant —
+        # only the replica and the checkpoint still hold its lists, so
+        # bit-identity PROVES the reroute
+        with lock:
+            mw = cell["mw"]
+            wrecked = dataclasses.replace(
+                mw.index,
+                vectors_sorted=jnp.asarray(mw.index.vectors_sorted)
+                .at[dead].set(0),
+                sorted_ids=jnp.asarray(mw.index.sorted_ids)
+                .at[dead].set(0),
+            )
+            mw2 = dataclasses.replace(mw, index=wrecked)
+            mw2._id_loc = None
+            cell["mw"] = mw2
+
+    sched = chaos.ChaosSchedule(scripted=scripted, seed=18)
+    sched.kill_rank(0.25, dead, wreck=wreck)
+    sched.straggler_window(0.45, gate, duration_s=0.2)
+    sched.heal_rank(0.9, dead)
+    sched.oscillate(1.6, 5, period_s=0.05, duration_s=0.25)
+
+    far = (30.0 * x[:160]).astype(np.float32)
+    acked = []
+    results = []
+    state = {"i": 0, "tick": 0}
+
+    def ingest_batch():
+        i = state["i"]
+        if 4 * (i + 1) > far.shape[0]:
+            return
+        state["i"] = i + 1
+        ids = np.arange(20000 + 4 * i, 20004 + 4 * i).astype(np.int32)
+        with lock:
+            mw2, acc = mnmg_upsert(
+                comms8, cell["mw"], far[4 * i:4 * i + 4], ids,
+                alive=health.mask(),
+            )
+            cell["mw"] = mw2
+        acked.extend(int(v) for v in ids[np.asarray(acc)])
+
+    def tick(t_s):
+        state["tick"] += 1
+        sup.step()
+        if state["tick"] % 4 == 0:
+            ingest_batch()                      # kill lands MID-ingest
+        truth = scripted.probe()
+        converged = all(monitor.is_up(r) == truth[r] for r in range(8))
+        res = ex.submit(q).result(timeout=120)
+        results.append((converged, res))
+
+    # -- the declarative invariants (the assertion framework) ---------
+    def check_results():
+        while results:
+            converged, res = results.pop(0)
+            if not converged:
+                continue
+            if float(np.asarray(res.coverage).min()) != 1.0:
+                return False
+            if not np.array_equal(np.asarray(res.ids), iref):
+                return False
+            if not np.array_equal(np.asarray(res.distances), vref):
+                return False
+        return True
+
+    def n_down_confirms():
+        return sum(1 for _, e, _ in sup.timeline()
+                   if e == "confirmed_down")
+
+    def n_pushes():
+        return sup.stats().route_pushes - pushes0
+
+    def no_acked_lost():
+        with lock:
+            mw = cell["mw"]
+        ids_arr = np.array(sorted(set(acked)), dtype=np.int64)
+        rows = far[ids_arr - 20000]
+        plan = FailoverPlan.load_balanced(placement, health)
+        for s in range(0, len(ids_arr), qcap):
+            chunk, idc = rows[s:s + qcap], ids_arr[s:s + qcap]
+            pad = np.zeros((qcap - chunk.shape[0], chunk.shape[1]),
+                           np.float32)
+            res = run(jnp.asarray(np.concatenate([chunk, pad], axis=0)),
+                      shard_mask=health.mask(), failover=plan)
+            if float(np.asarray(res.coverage).min()) != 1.0:
+                return False
+            if not np.array_equal(
+                np.asarray(res.ids)[:chunk.shape[0], 0], idc
+            ):
+                return False
+        return True
+
+    invariants = [
+        chaos.AlwaysInvariant(
+            "coverage-1-and-bit-identity-when-converged", check_results,
+        ),
+        chaos.ConvergenceInvariant(
+            "route-converges-within-deadline",
+            n_down_confirms, n_pushes, deadline_s=1.0,
+        ),
+        chaos.BoundInvariant(
+            "route-pushes-bounded-by-confirmed-transitions",
+            lambda: n_pushes() - monitor.transition_count, 0,
+        ),
+        chaos.BoundInvariant(
+            "zero-retraces", lambda: fn._cache_size() - size0, 0,
+        ),
+        chaos.FinalInvariant("zero-acked-writes-lost", no_acked_lost),
+        chaos.FinalInvariant(
+            "all-ranks-back-to-serving",
+            lambda: health.all_up and all(
+                s == STATE_SERVING for s in sup.stats().states.values()
+            ),
+        ),
+    ]
+    report = chaos.run_schedule(
+        sched, duration_s=4.0, invariants=invariants, tick=tick,
+        check_interval_s=0.002,
+    )
+    ex.close()
+    sup.close()
+    assert report.ok, report.summary()
+    # the schedule really exercised the loop
+    assert n_down_confirms() >= 1, "the kill must confirm"
+    assert sup.stats().heals_ok >= 1, "the supervisor must reintegrate"
+    assert len(acked) >= 8, "ingest must have acked mid-chaos"
+    assert state["tick"] >= 10 and gate.audit.calls >= 1
+    # zero retraces: the whole run reused the one warmed program object
+    assert all(f is fn for f in created), \
+        "every dispatch must reuse the cached program object"
+    # the postmortem names the supervisor's actions
+    assert recorder.events(event="supervisor_route_push")
+    assert recorder.events(event="supervisor_heal_step")
+
+
+# --------------------------------------------------- bench-row smoke
+class TestSelfHealRowSmoke:
+    def test_self_heal_row_tiny_config(self, dataset):
+        """The ISSUE-18 bench row end to end at a tiny CPU config: the
+        supervisor-driven kill→reroute→heal cycle under open-loop Zipf
+        load must stamp the acceptance evidence — detection_ms,
+        route_convergence_ms, reintegration_ms, per-phase p99s — with
+        every rank back to SERVING, without erroring."""
+        from bench.bench_serving import self_heal_row
+
+        x, q = dataset
+        row = self_heal_row(
+            np.asarray(x), np.asarray(q), k=K, n_probes=8,
+            n_lists=8, request_size=4,
+            kill_at_s=0.4, heal_at_s=1.2, duration_s=2.5,
+        )
+        assert row["scenario"] == "self_heal"
+        assert "error" not in row, row.get("error")
+        # the acceptance stamps are present and sane
+        for key in ("detection_ms", "route_convergence_ms",
+                    "reintegration_ms"):
+            assert row[key] >= 0.0, (key, row[key])
+        # detection precedes (or equals) route convergence by contract
+        assert row["route_convergence_ms"] >= row["detection_ms"]
+        # the loop really ran: a confirmed down+up, at least one push
+        # per confirmed transition but never more
+        assert row["transitions"] >= 2
+        assert 1 <= row["route_pushes"] <= row["transitions"] + 1
+        assert row["heals_ok"] >= 1
+        assert row["all_serving"] is True

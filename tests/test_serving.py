@@ -380,7 +380,8 @@ def test_serving_latency_rows_tiny_config():
         hedged=False, overload=False, mixed=False, open_loop=False,
         zipf=False,       # the zipf_hot_traffic row has its own smoke
         cold_tier=False,  # (tests/test_result_cache.py); the cold_tier
-    )                     # row's smoke lives in tests/test_tier.py
+        self_heal=False,  # row's smoke lives in tests/test_tier.py, the
+    )                     # self_heal row's in tests/test_chaos.py
     assert out["unit"] == "ms"
     assert [r["nq"] for r in out["rows"]] == [1, 4]
     for r in out["rows"]:
@@ -1350,5 +1351,96 @@ def test_round17_bench_line_parses_with_cold_tier():
         assert key not in benchtop._TRIM_ORDER
     for key in ("n_slots", "tier_fetches", "tier_degraded",
                 "tier_hit_rate_50", "tier_hit_rate_80", "hot_qps"):
+        assert key in benchtop._PRINT_KEYS
+        assert key in benchtop._TRIM_ORDER
+
+
+def test_round18_bench_line_parses_with_self_heal():
+    """ISSUE 18 satellite (the _fit_line parse/cap test extended,
+    following the r05-r17 pattern): the round-18 artifact shape — every
+    prior row PLUS the ``self_heal`` row (scripted kill→reroute→heal→
+    reintegrate under open-loop Zipf, docs/robustness.md
+    "Self-healing") — must print as a line that json.loads-round-trips
+    under the 1800-char driver cap, with the acceptance stamps
+    (``detection_ms``, ``route_convergence_ms``, ``reintegration_ms``,
+    ``healed_p99_x``, ``p99_ms_degraded``) untrimmable."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r18", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01}
+        for i in range(8)
+    ] + [
+        # the round-17 cold-tier row, unchanged
+        {"metric": "cold_tier_ivf_flat_500000x96", "unit": "QPS",
+         "scenario": "cold_tier", "engine": "ivf_flat", "nq": 1024,
+         "zipf_s": 1.1, "n_templates": 64, "n_slots": 512,
+         "capacity_x": 4.0, "program_qps": 1.8e5,
+         "hot_qps": 1.6e5, "tiered_qps": 1.4e5,
+         "qps_ratio_vs_hot": 0.875, "tier_hit_rate": 0.93,
+         "tier_hit_rate_50": 0.96, "tier_hit_rate_80": 0.94,
+         "tier_hit_rate_95": 0.91, "p99_ms_50": 6.1,
+         "p99_ms_80": 9.8, "p99_ms_95": 15.2,
+         "fetch_overlap_pct": 71.4, "tier_fetches": 812,
+         "recall_vs_hot": 0.982, "tier_degraded": False,
+         "spread": 0.03, "repeats": 5, "vs_prev": 1.0},
+        # the round-18 self-heal row under test
+        {"metric": "self_heal_ivf_flat_500000x96", "unit": "ms",
+         "scenario": "self_heal", "engine": "ivf_flat", "nq": 8,
+         "request_size": 8, "zipf_s": 1.1, "n_templates": 32,
+         "replication": 2, "n_ranks": 8, "rate_rps": 210.0,
+         "detection_ms": 112.4, "route_convergence_ms": 113.0,
+         "reintegration_ms": 41.7, "p99_ms_healthy": 9.8,
+         "p99_ms_degraded": 14.2, "p99_ms_healed": 10.1,
+         "healed_p99_x": 1.03, "route_pushes": 3, "heals_ok": 1,
+         "transitions": 2, "all_serving": True, "gen_lag_ms": 4.4,
+         "spread": 0.03, "repeats": 5, "vs_prev": 1.0},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "program_audit_ms": 34193.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # on a roomy line the row prints whole, acceptance stamps included
+    small = benchtop._fit_line({
+        "metric": "self_heal_ivf_flat_500000x96", "unit": "ms",
+        "detection_ms": 112.4, "route_convergence_ms": 113.0,
+        "reintegration_ms": 41.7, "healed_p99_x": 1.03,
+        "p99_ms_degraded": 14.2, "all_serving": True,
+        "extras": [],
+    })
+    small_parsed = json.loads(small)
+    assert small_parsed["detection_ms"] == 112.4
+    assert small_parsed["route_convergence_ms"] == 113.0
+    assert small_parsed["reintegration_ms"] == 41.7
+    assert small_parsed["healed_p99_x"] == 1.03
+    # the acceptance evidence is untrimmable; the secondaries trim
+    for key in ("detection_ms", "route_convergence_ms",
+                "reintegration_ms", "healed_p99_x", "p99_ms_degraded"):
+        assert key in benchtop._PRINT_KEYS
+        assert key not in benchtop._TRIM_ORDER
+    for key in ("route_pushes", "heals_ok", "transitions",
+                "all_serving", "rate_rps", "gen_lag_ms",
+                "p99_ms_healthy", "p99_ms_healed"):
         assert key in benchtop._PRINT_KEYS
         assert key in benchtop._TRIM_ORDER

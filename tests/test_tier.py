@@ -10,6 +10,8 @@ CPU host-sim at >= 0.95 of the hot-path recall. All tiny shapes, all
 CPU — behavior, never QPS (the QPS claim lives in
 bench/bench_serving.py's ``cold_tier_row``)."""
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -288,6 +290,75 @@ class TestSlabFetcher:
         s = st.stats()
         assert s.overlapped_fetches == 1 and s.fetches == 2
         assert s.fetch_overlap_pct == 50.0
+
+    def test_worker_crash_restarts_bounded_and_counted(self, flat_index):
+        """ISSUE 18 satellite: a promotion-batch crash no longer kills
+        the worker silently — the loop restarts (counted in
+        tier_fetcher_restarts_total) and the next fill proceeds."""
+        from raft_tpu.testing import chaos
+
+        st = make_store(flat_index, n_slots=4, name="crashy-restart")
+        restore = chaos.inject_worker_crash(st, times=1)
+        c = obsm.default_registry().counter(
+            "tier_fetcher_restarts_total", tier=st.name)
+        v0 = c.value
+        with SlabFetcher(st, window=1) as f:
+            f.request([4])                      # this batch crashes
+            deadline = time.monotonic() + 10
+            while f.stats()["restarts"] < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert f.stats()["restarts"] == 1 and not f.gave_up
+            assert c.value - v0 == 1
+            restore()
+            f.request([5])                      # the restarted loop fills
+            assert f.drain(20.0)
+            assert 5 in st.hot_lists().tolist()
+
+    # the final give-up re-raise IS the point — silence pytest's
+    # unhandled-thread-exception warning for it
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_worker_gives_up_after_max_restarts(self, flat_index):
+        """After max_restarts crashes the worker gives up DELIBERATELY:
+        fill sink detached (the store serves from its hot set), a
+        tier_fetcher_gave_up flight event, and the final exception
+        surfaced through thread_uncaught_total."""
+        from raft_tpu.testing import chaos
+
+        prev_obs = obsm.set_enabled(True)
+        try:
+            recorder = FlightRecorder(256, name="crashy")
+            st = make_store(flat_index, n_slots=4, name="crashy-giveup",
+                            flight=recorder)
+            chaos.inject_worker_crash(st, times=99)   # never recovers
+            f = SlabFetcher(st, window=1, max_restarts=1,
+                            name="crashy-giveup-fetch")
+            try:
+                f.request([4])
+                deadline = time.monotonic() + 10
+                while f._thread.is_alive() and time.monotonic() < deadline:
+                    f.request([5])              # keep feeding batches
+                    time.sleep(0.005)
+                assert not f._thread.is_alive()
+                assert f.gave_up
+                assert f.stats()["restarts"] == 2   # crash 1 restarted,
+                # crash 2 exhausted max_restarts=1 and gave up
+                gave = recorder.events(event="tier_fetcher_gave_up")
+                assert gave and gave[0]["tier"] == st.name
+                assert gave[0]["max_restarts"] == 1
+                snap = obsm.default_registry().snapshot()
+                assert any(
+                    row["labels"].get("thread") == "crashy-giveup-fetch"
+                    for row in snap.get("thread_uncaught_total", [])
+                ), "the final crash must surface in thread_uncaught_total"
+                # degraded serve-from-hot: the sink is detached, so a
+                # later request is a no-op (the producer API still works)
+                assert f.request([6]) in (0, 1)
+                assert st._fill_sink is None
+            finally:
+                f.close()
+        finally:
+            obsm.set_enabled(prev_obs)
 
 
 # ------------------------------------- mutation-epoch chaos (acceptance)
