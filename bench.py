@@ -1368,6 +1368,12 @@ _PRINT_KEYS = {
     "p99_ms_healthy", "p99_ms_degraded", "p99_ms_healed",
     "healed_p99_x", "route_pushes", "heals_ok", "transitions",
     "all_serving", "rate_rps", "gen_lag_ms",
+    # the graph-ANN row (ISSUE 19, docs/graph_ann.md): one-dispatch
+    # beam p50 vs the in-row IVF-Flat qcap-1 baseline at matched
+    # recall — p50_ms / recall_at_10 / ivf_p50_ms / ivf_recall_at_10
+    # are the acceptance, beam/degree/iters the served config
+    "ivf_p50_ms", "ivf_recall_at_10", "beam", "degree", "iters",
+    "ivf_qcap", "ivf_spread",
 }
 
 
@@ -1402,6 +1408,10 @@ _TRIM_ORDER = (
     "route_pushes", "heals_ok", "p99_ms_healthy", "p99_ms_healed",
     "n_slots", "tier_fetches", "tier_degraded",
     "tier_hit_rate_50", "tier_hit_rate_80", "hot_qps",
+    # graph_ann secondaries fall first; p50_ms / recall_at_10 /
+    # ivf_p50_ms / ivf_recall_at_10 / beam / degree / iters are
+    # acceptance evidence and stay untrimmable
+    "ivf_spread", "ivf_qcap",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",

@@ -1204,6 +1204,99 @@ def self_heal_row(x, qall, *, k: int = 10, n_probes: int = 16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def graph_ann_row(x, qall, ivf_index, *, k: int = 10,
+                  n_probes: int = 16, degree: int = 16,
+                  beams=(16, 32, 64), n_recall_q: int = 64,
+                  chain=(4, 32), escalate: int = 2) -> dict:
+    """The graph-ANN latency row (ISSUE 19, docs/graph_ann.md): the
+    low-latency acceptance priced IN-ROW — the one-dispatch beam search
+    at nq=1 vs the SAME corpus served by IVF-Flat at its
+    latency-profile qcap-1 point, recall measured against an exact
+    numpy oracle on ``n_recall_q`` queries. Stamps the graph arm's
+    ``p50_ms``/``recall_at_10``, the baseline's
+    ``ivf_p50_ms``/``ivf_recall_at_10``, and the ``beam``/``degree``/
+    ``iters`` actually served: the smallest beam in ``beams`` whose
+    recall lands within 0.01 of the baseline's (the acceptance bar —
+    equal-or-better recall first, then the latency comparison means
+    something)."""
+    from bench.common import chained_dispatch_stats, recall_at_k
+    from raft_tpu.spatial.ann import GraphParams, graph_build
+    from raft_tpu.spatial.ann.graph import graph_search
+    from raft_tpu.spatial.ann.ivf_flat import ivf_flat_search_grouped
+
+    xn = np.asarray(x, np.float32)
+    qn = np.asarray(qall, np.float32)
+    n, k_eff = xn.shape[0], min(k, xn.shape[0])
+    qr = qn[: min(n_recall_q, qn.shape[0])]
+    # exact oracle in numpy: no jit compile for the odd recall shape
+    d2 = ((qr * qr).sum(1)[:, None] + (xn * xn).sum(1)[None, :]
+          - 2.0 * (qr @ xn.T))
+    part = np.argpartition(d2, k_eff - 1, axis=1)[:, :k_eff]
+    true = np.take_along_axis(
+        part,
+        np.argsort(np.take_along_axis(d2, part, axis=1), axis=1),
+        axis=1,
+    )
+    row = {"engine": "graph", "scenario": "graph_ann", "nq": 1,
+           "degree": min(degree, n - 1)}
+
+    def p50_of(run, q1):
+        jax.block_until_ready(run(q1))
+        st = chained_dispatch_stats(
+            lambda s, q1=q1: q1 * (1.0 + 1e-6 * s), run,
+            n1=chain[0], n2=chain[1], escalate=escalate,
+        )
+        return st
+
+    # baseline arm: IVF-Flat at ITS latency point (qcap-1, the serving
+    # profile the graph index exists to beat)
+    qcap1 = ivf_index.warmup(1, k=k_eff, n_probes=n_probes)
+    row["ivf_qcap"] = qcap1
+
+    def run_ivf(qq):
+        return ivf_flat_search_grouped(
+            ivf_index, qq, k_eff, n_probes=n_probes, qcap=qcap1,
+        )
+
+    qcap_r = ivf_index.warmup(qr.shape[0], k=k_eff, n_probes=n_probes)
+    _, iv = ivf_flat_search_grouped(
+        ivf_index, jnp.asarray(qr), k_eff, n_probes=n_probes,
+        qcap=qcap_r,
+    )
+    ivf_rec = recall_at_k(iv, true)
+    row["ivf_recall_at_10"] = round(ivf_rec, 4)
+    st = p50_of(run_ivf, jnp.asarray(qn[:1]))
+    if st is not None:
+        row["ivf_p50_ms"] = round(st["ms"], 3)
+        row["ivf_spread"] = st["spread"]
+
+    # graph arm: smallest beam meeting the recall bar, then its p50
+    gidx = graph_build(xn, GraphParams(degree=row["degree"], seed=0),
+                       metric="sqeuclidean")
+    beam, rec = None, 0.0
+    for b in sorted({max(bm, k_eff) for bm in beams}):
+        _, gi = graph_search(gidx, jnp.asarray(qr), k_eff, beam=b)
+        beam, rec = b, recall_at_k(np.asarray(gi), true)
+        if rec >= ivf_rec - 0.01:
+            break
+    row["beam"] = beam
+    row["recall_at_10"] = round(rec, 4)
+    it = gidx.warmup(1, k=k_eff, beam=beam)
+    row["iters"] = it
+
+    def run_graph(qq):
+        return graph_search(gidx, qq, k_eff, beam=beam, iters=it)
+
+    st = p50_of(run_graph, jnp.asarray(qn[:1]))
+    if st is None:
+        row["error"] = "jitter-dominated"
+    else:
+        row["p50_ms"] = round(st["ms"], 3)
+        row["spread"] = st["spread"]
+        row["repeats"] = st["repeats"]
+    return row
+
+
 def serving_latency_rows(
     n: int = 500_000, d: int = 96, k: int = 10, n_probes: int = 16,
     n_lists: int = 2048, nqs=NQS, engines=("fused_knn", "ivf_flat",
@@ -1211,7 +1304,7 @@ def serving_latency_rows(
     chain=(4, 32), escalate: int = 2,
     hedged: bool = True, overload: bool = True, mixed: bool = True,
     open_loop: bool = True, zipf: bool = True, cold_tier: bool = True,
-    self_heal: bool = True,
+    self_heal: bool = True, graph: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
@@ -1444,6 +1537,21 @@ def serving_latency_rows(
         except Exception as e:                       # noqa: BLE001
             rows.append({
                 "engine": "ivf_flat", "scenario": "self_heal",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+
+    # the graph-ANN low-latency row (ISSUE 19): one-dispatch beam
+    # search vs the IVF-Flat qcap-1 baseline at matched recall
+    if graph and "ivf_flat" in engines:
+        try:
+            rows.append(graph_ann_row(
+                np.asarray(x), np.asarray(qall),
+                get_index("ivf_flat"), k=k, n_probes=n_probes,
+                chain=chain, escalate=escalate,
+            ))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "graph", "scenario": "graph_ann",
                 "error": f"{type(e).__name__}: {e}"[:160],
             })
 

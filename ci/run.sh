@@ -119,6 +119,18 @@ run_tier_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_tier.py -q
 }
 
+run_graph_smoke() {
+    # Graph-ANN smoke (ISSUE 19, docs/graph_ann.md): build + beam
+    # search on the CPU drive — structural invariants, oracle recall,
+    # rerank-tail bit-identity, tombstone parity, zero-retrace audits,
+    # interpret-mode kernel vs lax mirror, serialize/corrupt, placed
+    # replication. Fails fast before the long mesh run (which repeats
+    # it).
+    echo "== graph-ANN smoke (tests/test_graph_ann.py) =="
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_graph_ann.py -q
+}
+
 run_chaos() {
     # Self-healing chaos smoke (ISSUE 18, docs/robustness.md
     # "Self-healing"): the scripted chaos-schedule harness drives the
@@ -172,12 +184,13 @@ case "$stage" in
     x64) run_x64 ;;
     docs) run_docs ;;
     tier) run_tier_smoke ;;
+    graph) run_graph_smoke ;;
     chaos) run_chaos ;;
     multihost) run_multihost_smoke ;;
     all) run_style; run_programs; run_threads; run_install_check; \
-         run_docs; run_x64; run_tier_smoke; run_chaos; \
-         run_multihost_smoke; run_tests ;;
-    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|tier|chaos|multihost|all)"
+         run_docs; run_x64; run_tier_smoke; run_graph_smoke; \
+         run_chaos; run_multihost_smoke; run_tests ;;
+    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|tier|graph|chaos|multihost|all)"
        exit 2 ;;
 esac
 echo "CI: OK"

@@ -140,6 +140,14 @@ class _World:
         ))
 
     @property
+    def graph_index(self):
+        from raft_tpu.spatial.ann import GraphParams, graph_build
+
+        return self._memo("graph", lambda: graph_build(
+            self.x, GraphParams(degree=8, seed=0)
+        ))
+
+    @property
     def comms(self):
         import jax
 
@@ -259,6 +267,35 @@ def trace_pq_grouped(index, nq: int, k: int, n_probes: int, qcap: int,
         "max_list": int(index.storage.max_list),
         "engine": "pallas" if use_pallas else "xla",
         "allow_wide_tile": not use_pallas,
+    }
+    meta.update(extra_meta or {})
+    return record_from_traced(name, traced, meta)
+
+
+def trace_graph_beam(index, nq: int, k: int, beam: int, iters: int,
+                     hash_bits: int, *, with_mask: bool = False,
+                     use_pallas: bool = False,
+                     pallas_interpret: bool = True,
+                     name: str = "graph_beam",
+                     extra_meta: Optional[dict] = None) -> ProgramRecord:
+    """Trace the ONE beam-search body with the serving wrapper's statics
+    — the audit twin of ``graph_search`` / ``GraphIndex.warmup``
+    (spatial/ann/graph.py). ``with_mask`` traces the tombstone variant
+    (the ``row_mask`` runtime operand in the signature)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.graph import _beam_impl, graph_live_mask
+
+    q0 = jnp.zeros((nq, index.data_padded.shape[1]), jnp.float32)
+    mask = graph_live_mask(index) if with_mask else None
+    traced = _beam_impl.trace(
+        index, q0, k, beam, iters, hash_bits, mask,
+        use_pallas=use_pallas, pallas_interpret=pallas_interpret,
+    )
+    meta = {
+        "nq": nq, "k": k, "beam": beam, "iters": iters,
+        "hash_bits": hash_bits, "degree": int(index.storage.degree),
+        "engine": "pallas" if use_pallas else "xla", "graph": True,
     }
     meta.update(extra_meta or {})
     return record_from_traced(name, traced, meta)
@@ -427,6 +464,44 @@ def _flat_tiered(w: _World, count: bool) -> ProgramRecord:
          "n_slots": 4,
          "max_list": int(w.flat_index.storage.max_list),
          "tiered": True, "engine": "xla", "allow_wide_tile": True},
+        program_count=flip_census(prep, flips) if count else None,
+    )
+
+
+@_spec("graph_beam",
+       "graph-ANN one-dispatch beam search (fixed-degree adjacency, "
+       "bounded-hash visited set, exact f32 rerank tail) — the "
+       "tombstone delete/restore (upsert-by-restore) flip census runs "
+       "here; health/route flips never reach this program's operands, "
+       "so the census covers every runtime input it has")
+def _graph_beam(w: _World, count: bool) -> ProgramRecord:
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.ann.graph import _beam_impl
+
+    gi = w.graph_index
+    q0 = jnp.zeros((_NQ, _D), jnp.float32)
+    beam, iters, hb = 8, 8, 12
+
+    def prep(dead=(), restored=()):
+        # tombstone VALUE flips only — delete a row, delete another,
+        # restore the first (the upsert-by-restore mutation cycle);
+        # every entry must prepare the SAME program
+        rm = np.ones((_N,), np.int8)
+        rm[list(dead)] = 0
+        rm[list(restored)] = 1
+        args = (gi, q0, _K, beam, iters, hb, jnp.asarray(rm))
+        return _beam_impl, args, None
+
+    flips = [dict(), dict(dead=(5,)), dict(dead=(5, 11)),
+             dict(dead=(5, 11), restored=(5,))]
+    fn, args, _ = prep(**flips[0])
+    traced = fn.trace(*args, use_pallas=False, pallas_interpret=False)
+    return record_from_traced(
+        "graph_beam", traced,
+        {"nq": _NQ, "k": _K, "beam": beam, "iters": iters,
+         "hash_bits": hb, "degree": int(gi.storage.degree),
+         "engine": "xla", "graph": True, "mutation": True},
         program_count=flip_census(prep, flips) if count else None,
     )
 

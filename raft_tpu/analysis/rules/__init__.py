@@ -24,6 +24,7 @@ def _collect() -> List[Rule]:
     from raft_tpu.analysis.rules import (
         adc_gather,
         api_compat,
+        data_dependent_loop_bound,
         dcn_wide_collective,
         host_fetch_in_traced_body,
         metrics_in_traced_body,
@@ -41,7 +42,8 @@ def _collect() -> List[Rule]:
                 x64_hygiene, prng_discipline, adc_gather,
                 mutation_retrace, sync_in_hot_path,
                 dcn_wide_collective, metrics_in_traced_body,
-                host_fetch_in_traced_body, stale_epoch_read):
+                host_fetch_in_traced_body, stale_epoch_read,
+                data_dependent_loop_bound):
         out.extend(mod.RULES)
     return out
 

@@ -1,0 +1,191 @@
+"""Rule ``data-dependent-loop-bound``: a loop inside a traced body
+whose trip count flows from a traced value through a host coercion.
+
+The beam-search retrace hazard (raft_tpu/spatial/ann/graph.py,
+docs/graph_ann.md): a CPU graph-ANN implementation loops "until the
+frontier converges" — a trip count read off the data. Spelled inside a
+traced body that becomes ``range(int(n_active))``,
+``while int(frontier_size) > 0:``, or
+``lax.fori_loop(0, int(hops), ...)`` — each of which either raises a
+``TracerConversionError`` at trace time or, when the value happens to
+be concrete (a numpy input, a constant-folded intermediate), silently
+bakes THIS batch's trip count into the compiled program, so the next
+batch with a different value retraces — or worse, reuses the wrong
+bound. Trip counts of traced loops must be trace-time statics derived
+from shapes/params (the static ``iters`` discipline), or the loop must
+be a ``lax.while_loop`` on the runtime value.
+
+Flagged INSIDE traced bodies only (host orchestration loops freely),
+when a loop-bound position contains a host coercion of a value that
+flows from a NONSTATIC parameter of the traced callable:
+
+* ``for ... in range(int(x))`` / ``range(x.item())`` — a Python loop
+  bound read off a traced operand;
+* ``while`` whose test coerces such a value via ``int()`` / ``bool()``
+  / ``float()`` / ``.item()`` / ``.tolist()``;
+* ``lax.fori_loop`` / ``lax.scan(..., length=...)`` whose static
+  bound/length argument is built from such a coercion.
+
+``int(x.shape[0])``, ``len(x)``, and ``x.ndim`` reads are exempt —
+shapes are trace-time statics — as are coercions that reference only
+the callable's declared STATIC parameters. Suppress with
+``# jaxlint: disable=data-dependent-loop-bound`` where the coerced
+value is genuinely concrete at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from raft_tpu.analysis.rules import Rule
+
+_COERCIONS = {"int", "bool", "float"}
+_HOST_METHODS = {"item", "tolist"}
+_SHAPE_ATTRS = {"shape", "ndim"}
+_FORI = {"jax.lax.fori_loop", "lax.fori_loop", "fori_loop"}
+_SCAN = {"jax.lax.scan", "lax.scan", "scan"}
+
+
+def _tainted_name(expr: ast.AST, nonstatic: Set[str]) -> Optional[str]:
+    """The first nonstatic-parameter name referenced in ``expr`` outside
+    a shape read (``x.shape[...]`` / ``x.ndim`` / ``len(x)``), or None.
+    Shape reads are trace-time statics however traced their base is."""
+    def scan(node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            return None
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+        ):
+            return None
+        if isinstance(node, ast.Name) and node.id in nonstatic:
+            return node.id
+        for child in ast.iter_child_nodes(node):
+            hit = scan(child)
+            if hit is not None:
+                return hit
+        return None
+
+    return scan(expr)
+
+
+def _coercion_of_traced(expr: ast.AST,
+                        nonstatic: Set[str]) -> Optional[str]:
+    """Description of the first host coercion in ``expr`` whose operand
+    flows from a nonstatic parameter — ``int(n_active)`` /
+    ``frontier.item()`` — or None."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Name) and fn.id in _COERCIONS
+            and node.args
+        ):
+            hit = _tainted_name(node.args[0], nonstatic)
+            if hit is not None:
+                return f"{fn.id}(...{hit}...)"
+        elif isinstance(fn, ast.Attribute) and fn.attr in _HOST_METHODS:
+            hit = _tainted_name(fn.value, nonstatic)
+            if hit is not None:
+                return f"{hit}.{fn.attr}()"
+    return None
+
+
+class DataDependentLoopBoundRule(Rule):
+    name = "data-dependent-loop-bound"
+    description = (
+        "traced loop trip count coerced from a runtime value — the "
+        "program retraces (or freezes one batch's bound) per value"
+    )
+
+    def _check_for(self, ctx, node: ast.For,
+                   nonstatic: Set[str]) -> Iterator:
+        it = node.iter
+        # unwrap reversed(range(...)) / enumerate(range(...))
+        while (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id in ("reversed", "enumerate")
+            and it.args
+        ):
+            it = it.args[0]
+        if not (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range"
+        ):
+            return
+        for arg in it.args:
+            what = _coercion_of_traced(arg, nonstatic)
+            if what is not None:
+                yield ctx.finding(
+                    self.name, node.iter,
+                    f"range bound {what} inside a traced body — a "
+                    "data-dependent trip count retraces per value (or "
+                    "freezes this batch's); derive the bound from "
+                    "shapes/static params, or use lax.while_loop on "
+                    "the runtime value",
+                )
+                return
+
+    def _check_while(self, ctx, node: ast.While,
+                     nonstatic: Set[str]) -> Iterator:
+        what = _coercion_of_traced(node.test, nonstatic)
+        if what is not None:
+            yield ctx.finding(
+                self.name, node.test,
+                f"`while` on {what} inside a traced body — the "
+                "convergence test reads a traced value back to the "
+                "host (retrace per value); use a static iteration "
+                "budget or lax.while_loop on the runtime value",
+            )
+
+    def _check_lax_call(self, ctx, call: ast.Call,
+                        nonstatic: Set[str]) -> Iterator:
+        callee = ctx.facts.callee(call)
+        if callee in _FORI:
+            for arg in call.args[:2]:       # (lower, upper, body, init)
+                what = _coercion_of_traced(arg, nonstatic)
+                if what is not None:
+                    yield ctx.finding(
+                        self.name, call,
+                        f"lax.fori_loop bound {what} — fori bounds are "
+                        "trace-time statics, so a coerced runtime "
+                        "value retraces per value; use a static hop "
+                        "budget or lax.while_loop",
+                    )
+                    return
+        elif callee in _SCAN:
+            for kw in call.keywords:
+                if kw.arg != "length":
+                    continue
+                what = _coercion_of_traced(kw.value, nonstatic)
+                if what is not None:
+                    yield ctx.finding(
+                        self.name, call,
+                        f"lax.scan length={what} — the scan length is "
+                        "a trace-time static, so a coerced runtime "
+                        "value retraces per value",
+                    )
+                    return
+
+    def check(self, ctx) -> Iterator:
+        seen: set = set()          # nested traced fns share body nodes
+        for fn in ctx.facts.traced:
+            nonstatic = ctx.facts.nonstatic_params(fn)
+            for node in ctx.facts.traced_body_nodes(fn):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                if isinstance(node, ast.For):
+                    yield from self._check_for(ctx, node, nonstatic)
+                elif isinstance(node, ast.While):
+                    yield from self._check_while(ctx, node, nonstatic)
+                elif isinstance(node, ast.Call):
+                    yield from self._check_lax_call(ctx, node, nonstatic)
+
+
+RULES = [DataDependentLoopBoundRule()]

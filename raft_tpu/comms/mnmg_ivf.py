@@ -1023,7 +1023,37 @@ def place_index(comms: Comms, index, *,
     HOST-AWARE stripe instead — :func:`raft_tpu.comms.multihost.
     host_aware_offset` steps copies by whole hosts, so a whole dead
     host still leaves every shard a live copy — docs/multihost.md
-    "Host-aware placement")."""
+    "Host-aware placement").
+
+    An index with NO sharded fields (the graph-ANN
+    :class:`~raft_tpu.spatial.ann.graph.GraphIndex` — a low-latency
+    design whose working set fits one chip) replicates whole onto every
+    device: every array leaf lands fully-replicated on the mesh, so the
+    supervisor/result-cache tier serves it through the same placement
+    entry as the IVF engines. ``replication``/``replica_offset`` are
+    meaningless for (and rejected on) such an index — every rank
+    already holds a full copy."""
+    field_names = {f.name for f in dataclasses.fields(type(index))}
+    if not (field_names & _SHARDED_FIELDS):
+        errors.expects(
+            replication is None and replica_offset is None,
+            "place_index: index type %s has no sharded fields — it "
+            "replicates whole; replication/replica_offset do not apply",
+            type(index).__name__,
+        )
+        sh = NamedSharding(comms.mesh, P())
+        kw = {}
+        for f in dataclasses.fields(type(index)):
+            v = getattr(index, f.name)
+            if v is not None and f.metadata.get("static") is None:
+                if dataclasses.is_dataclass(v):
+                    v = compat.tree_map(
+                        lambda a: jax.device_put(a, sh), v
+                    )
+                else:
+                    v = jax.device_put(v, sh)
+            kw[f.name] = v
+        return type(index)(**kw)
     n_ranks = index.sorted_ids.shape[0]
     if replica_offset is None and replication is not None \
             and int(replication) > 1:

@@ -1,7 +1,9 @@
 """ANN indexes — first-class TPU implementations (the reference wraps FAISS,
 cpp/include/raft/spatial/knn/detail/ann_quantized_faiss.cuh; SURVEY.md §2
 #19-20 mandates native IVF here): IVF-Flat, IVF-PQ, IVF-SQ, random ball
-cover, all on a shared sorted-by-list storage layout.
+cover, all on a shared sorted-by-list storage layout — plus the
+fixed-degree graph-ANN index (graph.py, CAGRA-style) for the
+low-latency regime.
 """
 
 from raft_tpu.spatial.ann.common import ListStorage, build_list_storage
@@ -28,6 +30,16 @@ from raft_tpu.spatial.ann.ivf_sq import (
 )
 from raft_tpu.spatial.ann.approx import (
     approx_knn_build_index, approx_knn_search,
+)
+from raft_tpu.spatial.ann.graph import (
+    GraphIndex,
+    GraphParams,
+    GraphStorage,
+    graph_build,
+    graph_delete,
+    graph_live_mask,
+    graph_restore,
+    graph_search,
 )
 from raft_tpu.spatial.ann.serialize import save_index, load_index
 from raft_tpu.spatial.ann.mutation import (
@@ -62,6 +74,8 @@ __all__ = [
     "IVFSQParams", "IVFSQIndex", "ivf_sq_build", "ivf_sq_search",
     "ivf_sq_search_grouped",
     "BallCoverIndex", "rbc_build_index", "rbc_knn_query", "rbc_all_knn_query",
+    "GraphParams", "GraphStorage", "GraphIndex", "graph_build",
+    "graph_search", "graph_live_mask", "graph_delete", "graph_restore",
     "save_index", "load_index",
     "approx_knn_build_index", "approx_knn_search",
     "MutableIndex", "DeltaStore", "wrap_mutable", "upsert", "delete",

@@ -1,0 +1,129 @@
+"""Pallas distance engine for the graph-ANN beam search (ISSUE 19) — a
+thin instantiation of the shared scan-kernel core
+(:mod:`raft_tpu.spatial.ann.scan_core`), exactly like ``flat_kernel``:
+the tile planner, the [lo, hi) masking, the 8-row sub-chunk-min select,
+and the lax-mirror discipline all live in the core; this module
+contributes only the beam search's operand layout.
+
+The beam search's per-iteration hot loop scores each query's gathered
+candidate rows (``beam x degree`` of them) against that one query. The
+batch axis of the scan is therefore the *query block* (LB = padded query
+count), not an IVF list block:
+
+* the **resident** operand is each query's own row, padded to the bf16
+  sublane granule — ``(NQ, Q_GRANULE, d)`` with only slot 0 live;
+* the **tiled** operand is the gathered candidate rows, transposed so
+  the candidate axis is lane-aligned — ``(NQ, d, Cpad)`` bf16 streamed
+  as ``(d, l_tile)`` blocks;
+* ``bounds`` (NQ, 2) int32 marks the per-query valid candidate range
+  ``[0, c_valid)``; padded/invalid candidates score the finite BIG and
+  order last in the pooled merge.
+
+Only the ``(NQ, Q_GRANULE, Cpad/8)`` sub-chunk minima reach HBM — the
+same fused_knn cover argument as the grouped engines: every rank-``c``
+candidate lives in a sub-chunk whose minimum is <= the c-th best value,
+so the top sub-chunks by minimum contain the top rows, and the beam's
+pool merge plus the exact f32 rerank tail (``score_l2_candidates``, the
+grouped engines' one rerank authority) absorb the bf16 ranking noise at
+the pool boundary. Returned distances are exact.
+
+CPU/tier-1: the kernel runs under ``interpret=True`` and
+:func:`beam_scan_subchunk_min_lax` is the op-for-op XLA mirror the
+tests pin the kernel against bitwise. Importing this module never
+builds a TPU program; ``JAX_PLATFORMS=cpu`` callers reach it only when
+they explicitly opt in with ``use_pallas=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax.numpy as jnp
+
+from raft_tpu.spatial.ann import scan_core
+from raft_tpu.spatial.ann.scan_core import (
+    BIG as BIG,  # re-export: callers read the masked-row constant here
+    SUBCHUNK,
+    pad_queries,
+)
+
+__all__ = [
+    "SUBCHUNK", "pad_queries", "plan_l_tile", "beam_scan_subchunk_min",
+    "beam_scan_subchunk_min_lax", "beam_scan_supported",
+]
+
+
+def _step_bytes(d: int, q_pad: int, l_tile: int) -> int:
+    # candidate tile (d, Lt) bf16 (x2: pipelined block) + the query's
+    # padded row block (Qp, d) bf16 (x2: resident, double-buffered per
+    # query) + d2 (Qp, Lt) f32
+    return 2 * 2 * d * l_tile + 2 * 2 * q_pad * d + 4 * q_pad * l_tile
+
+
+def plan_l_tile(d: int, q_pad: int,
+                l_tile: typing.Optional[int] = None,
+                profile: str = "latency"):
+    """The beam engine's byte model handed to the ONE shared planner
+    (:func:`raft_tpu.spatial.ann.scan_core.plan_l_tile`). The default
+    profile is ``"latency"``: the beam search IS the qcap-small serving
+    regime (one query row per batch slot), so the wider start tile is
+    always affordable."""
+    return scan_core.plan_l_tile(
+        functools.partial(_step_bytes, d), q_pad, l_tile, profile
+    )
+
+
+def beam_scan_supported(d: int, c_pad: int) -> bool:
+    """Whether the Pallas beam-scan engine applies at this config: one
+    (query, candidate-tile) step fits the VMEM plan. The query block is
+    a single padded row (``pad_queries(1)``), so this only fails at an
+    extreme d; ``c_pad`` must land on the lane granule (the caller pads
+    the candidate buffer once at build time)."""
+    if d < 1 or c_pad < 1 or c_pad % scan_core.LANE:
+        return False
+    return plan_l_tile(d, pad_queries(1)) is not None
+
+
+def beam_scan_subchunk_min(qrows, cands_t, bounds, *, interpret: bool,
+                           l_tile: int = 256):
+    """(NQ, Q, d) padded query rows x (NQ, d, Cpad) gathered candidate
+    rows -> (NQ, Q, Cpad/8) f32 sub-chunk squared-L2 minima (bf16
+    operands, f32 accumulation/norms).
+
+    ``bounds`` (NQ, 2) int32: per-query valid candidate range [lo, hi)
+    (columns outside score BIG). Q must be a multiple of 16 (bf16
+    sublane tile; only slot 0 carries a live query — the rest are
+    padding the caller drops) and Cpad a multiple of ``l_tile`` (itself
+    a multiple of 128)."""
+    nq, q_pad, d = qrows.shape
+    d_c = cands_t.shape[1]
+    if d_c != d:
+        raise ValueError(
+            f"beam_scan_subchunk_min: query dim {d} != candidate dim {d_c}"
+        )
+
+    def tile_fn(res, til, bc):
+        # (Qp, d) bf16 query block x (d, Lt) bf16 candidate tile -> the
+        # shared flat-family distance body
+        return scan_core.l2_gram_tile(res[0], til[0])
+
+    return scan_core.subchunk_scan(
+        tile_fn, bounds,
+        [qrows.astype(jnp.bfloat16)], [cands_t.astype(jnp.bfloat16)],
+        l_tile=l_tile, interpret=interpret,
+        name="beam_scan_subchunk_min",
+    )
+
+
+def beam_scan_subchunk_min_lax(qrows, cands_t, bounds):
+    """Op-for-op XLA mirror of :func:`beam_scan_subchunk_min` (same bf16
+    contraction with f32 accumulation, same f32 norm terms, same masking
+    and sub-chunk reduce via ``scan_core.mask_subchunk_min_lax``) — the
+    bit-compat reference the tier-1 tests pin the interpret-mode kernel
+    against, and the engine's fallback wherever ``pallas_call`` is
+    unavailable."""
+    d2 = scan_core.l2_gram_tile(
+        qrows.astype(jnp.bfloat16), cands_t.astype(jnp.bfloat16)
+    )                                                  # (NQ, Qp, Cp) f32
+    return scan_core.mask_subchunk_min_lax(d2, bounds)

@@ -21,7 +21,10 @@ quantizer (:class:`raft_tpu.spatial.ann.common.CoarseIndex`, nested
 under ``coarse.*`` keys and CRC-manifested like every other array);
 v4 adds the mutation tier (a
 :class:`raft_tpu.spatial.ann.mutation.MutableIndex` payload — delta
-segments, tombstone mask, id map; docs/mutation.md "Checkpoint v4").
+segments, tombstone mask, id map; docs/mutation.md "Checkpoint v4");
+v5 adds the graph-ANN index (a
+:class:`raft_tpu.spatial.ann.graph.GraphIndex` payload with its nested
+``GraphStorage`` adjacency; docs/graph_ann.md).
 Older files still load (``coarse`` comes back ``None`` from v2/v1),
 the writer stamps the LOWEST version representing the payload, and a
 FUTURE version is rejected with a ``CorruptIndexError`` naming it — a
@@ -44,6 +47,7 @@ import numpy as np
 
 from raft_tpu import errors
 from raft_tpu.spatial.ann.common import CoarseIndex, ListStorage
+from raft_tpu.spatial.ann.graph import GraphIndex, GraphStorage
 from raft_tpu.spatial.ann.ivf_flat import IVFFlatIndex
 from raft_tpu.spatial.ann.ivf_pq import IVFPQIndex
 from raft_tpu.spatial.ann.ivf_sq import IVFSQIndex
@@ -51,18 +55,21 @@ from raft_tpu.sparse.distance import SparseColBlockIndex
 
 __all__ = ["save_index", "load_index"]
 
-_VERSION = 4
+_VERSION = 5
 # v1 = no integrity manifest (read-compat: loads without verification);
 # v2 = manifest but no two-level coarse quantizer (loads, coarse=None);
 # v3 = + coarse quantizer; v4 = + mutation tier (a MutableIndex payload
-# with DeltaStore segments — spatial/ann/mutation.py)
-_READABLE_VERSIONS = (1, 2, 3, 4)
+# with DeltaStore segments — spatial/ann/mutation.py); v5 = + graph-ANN
+# index (a GraphIndex payload with nested GraphStorage —
+# spatial/ann/graph.py)
+_READABLE_VERSIONS = (1, 2, 3, 4, 5)
 
 _TYPES = {
     "ivf_flat": IVFFlatIndex,
     "ivf_pq": IVFPQIndex,
     "ivf_sq": IVFSQIndex,
     "sparse_colblock": SparseColBlockIndex,
+    "graph": GraphIndex,
 }
 
 
@@ -103,7 +110,11 @@ def _register_mutable() -> None:
 
 _NAMES = {v: k for k, v in _TYPES.items()}
 # nested dataclasses that may appear inside an index payload
-_NESTED = {"ListStorage": ListStorage, "CoarseIndex": CoarseIndex}
+_NESTED = {
+    "ListStorage": ListStorage,
+    "CoarseIndex": CoarseIndex,
+    "GraphStorage": GraphStorage,
+}
 
 
 def _flatten(obj: Any, prefix: str, arrays: dict, static: dict) -> None:
@@ -146,10 +157,11 @@ def save_index(index, path) -> None:
     """Serialize an ANN / sparse index to ``path`` (``.npz``; the header
     carries a per-array CRC32/shape/dtype integrity manifest that
     :func:`load_index` verifies). The stamped version is the LOWEST one
-    that can represent the payload — v4 only for a mutation-tier
-    payload, v3 only when a two-level coarse quantizer is attached, v2
-    otherwise — so checkpoints without the new fields stay loadable by
-    previous releases (rollback/mixed-version fleets)."""
+    that can represent the payload — v5 only for a graph-ANN payload,
+    v4 only for a mutation-tier payload, v3 only when a two-level
+    coarse quantizer is attached, v2 otherwise — so checkpoints without
+    the new fields stay loadable by previous releases
+    (rollback/mixed-version fleets)."""
     if type(index) not in _NAMES:
         _register_sharded()
         _register_mutable()
@@ -162,14 +174,15 @@ def save_index(index, path) -> None:
     static: dict = {}
     _flatten(index, "", arrays, static)
     # lowest version representing the payload (rollback/mixed-version
-    # fleets): v4 only for a mutation-tier payload, v3 only when a
-    # coarse quantizer is attached, v2 otherwise
+    # fleets): v5 only for a graph payload, v4 only for a mutation-tier
+    # payload, v3 only when a coarse quantizer is attached, v2 otherwise
     nested = {
         v.get("__nested__")
         for v in static.values() if isinstance(v, dict)
     }
     version = (
-        4 if "DeltaStore" in nested
+        5 if "GraphStorage" in nested
+        else 4 if "DeltaStore" in nested
         else 3 if "CoarseIndex" in nested
         else 2
     )

@@ -1539,6 +1539,109 @@ def test_stale_epoch_read_suppression_honored():
     assert out == []
 
 
+# -- data-dependent-loop-bound -----------------------------------------------
+
+def loopbound_findings(src):
+    return findings(src, "data-dependent-loop-bound")
+
+
+def test_loop_bound_flags_range_of_coerced_operand():
+    # the beam-search hazard: a Python loop bound read off a traced
+    # value — bakes this batch's trip count into the program
+    out = loopbound_findings("""
+        import jax
+
+        @jax.jit
+        def search(q, n_active):
+            acc = q
+            for _ in range(int(n_active)):
+                acc = acc + 1
+            return acc
+    """)
+    assert len(out) == 1
+    assert "range bound int(...n_active...)" in out[0].message
+    assert "lax.while_loop" in out[0].message
+
+
+def test_loop_bound_flags_while_on_item():
+    out = loopbound_findings("""
+        import jax
+
+        @jax.jit
+        def converge(frontier, x):
+            while frontier.item() > 0:
+                x = x * 2
+            return x
+    """)
+    assert len(out) == 1
+    assert "frontier.item()" in out[0].message
+
+
+def test_loop_bound_flags_fori_and_scan_length():
+    out = loopbound_findings("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def hop(x, hops):
+            y = lax.fori_loop(0, int(hops), lambda i, s: s + 1, x)
+            z, _ = lax.scan(lambda c, _: (c, c), y, None,
+                            length=int(hops))
+            return z
+    """)
+    assert len(out) == 2
+    msgs = " ".join(f.message for f in out)
+    assert "fori_loop bound" in msgs and "scan length" in msgs
+
+
+def test_loop_bound_shape_derived_and_static_clean():
+    # shapes are trace-time statics however traced their base is, and
+    # declared static params are statics by definition — the intended
+    # `iters` discipline must never be flagged
+    out = loopbound_findings("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("iters",))
+        def search(q, iters):
+            for _ in range(int(iters)):
+                q = q + 1
+            for _ in range(int(q.shape[0])):
+                q = q * 2
+            for _ in range(len(q)):
+                q = q - 1
+            while int(q.ndim) > 3:
+                q = q[0]
+            return q
+    """)
+    assert out == []
+
+
+def test_loop_bound_host_loop_clean():
+    # host orchestration loops over runtime values freely — only
+    # traced bodies are in scope
+    out = loopbound_findings("""
+        def drive(batches, fn):
+            for b in range(int(batches)):
+                fn(b)
+            return None
+    """)
+    assert out == []
+
+
+def test_loop_bound_suppression_honored():
+    out = loopbound_findings("""
+        import jax
+
+        @jax.jit
+        def search(q, n_const):
+            for _ in range(int(n_const)):  # jaxlint: disable=data-dependent-loop-bound
+                q = q + 1
+            return q
+    """)
+    assert out == []
+
+
 def test_baseline_respected_and_counted(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(FIXTURE_BAD)

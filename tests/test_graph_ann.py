@@ -1,0 +1,356 @@
+"""Graph-ANN index tests (ISSUE 19): build determinism + structural
+invariants, beam-search recall vs the exact oracle, bit-identity of the
+exact rerank tail, tombstone mutation parity, zero-retrace audits
+(single-chip and placed), serialization round-trip + corruption, and
+the CPU never-imports-the-kernel guarantee. The Pallas beam-scan kernel
+itself runs here in interpret mode against its lax mirror (bitwise, on
+an integer grid); compiled-TPU parity rides the same helpers on
+hardware."""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.spatial.ann import (
+    GraphParams,
+    graph_build,
+    graph_delete,
+    graph_live_mask,
+    graph_restore,
+    graph_search,
+    load_index,
+    save_index,
+)
+from raft_tpu.spatial.ann.graph import _beam_impl
+from tests.oracles import np_knn_ids
+
+
+def recall(ids, oracle):
+    hits = sum(
+        len(set(a[a >= 0]) & set(b)) for a, b in zip(ids, oracle)
+    )
+    return hits / oracle.size
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((400, 16)).astype(np.float32)
+    q = x[::37][:8] + 0.05 * rng.standard_normal((8, 16)).astype(
+        np.float32
+    )
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def gindex(dataset):
+    return graph_build(dataset[0], GraphParams(degree=8, seed=0))
+
+
+# -- construction ------------------------------------------------------------
+
+
+def test_build_deterministic(dataset):
+    x, _ = dataset
+    a = graph_build(x, GraphParams(degree=8, seed=0))
+    b = graph_build(x, GraphParams(degree=8, seed=0))
+    np.testing.assert_array_equal(np.asarray(a.storage.adjacency),
+                                  np.asarray(b.storage.adjacency))
+    np.testing.assert_array_equal(np.asarray(a.storage.entries),
+                                  np.asarray(b.storage.entries))
+    np.testing.assert_array_equal(np.asarray(a.data_padded),
+                                  np.asarray(b.data_padded))
+    c = graph_build(x, GraphParams(degree=8, seed=1))
+    assert not np.array_equal(np.asarray(a.storage.entries),
+                              np.asarray(c.storage.entries))
+
+
+def test_adjacency_invariants(dataset, gindex):
+    x, _ = dataset
+    n = x.shape[0]
+    adj = np.asarray(gindex.storage.adjacency)
+    assert adj.shape == (n + 1, 8) and adj.dtype == np.int32
+    # sentinel row: all invalid (the padded node expands to nothing)
+    assert (adj[n] == -1).all()
+    body = adj[:n]
+    assert ((body >= -1) & (body < n)).all()
+    # no self edges
+    assert (body != np.arange(n)[:, None]).all()
+    # no duplicate ids within a row (beyond -1 padding)
+    for r in range(n):
+        real = body[r][body[r] >= 0]
+        assert len(real) == len(set(real.tolist()))
+    # n >> degree and symmetrize gives every node >= degree candidates,
+    # so the fixed-degree back-fill leaves no -1 in real rows here
+    assert (body >= 0).all()
+    # entries: sorted, unique, in range
+    e = np.asarray(gindex.storage.entries)
+    assert (np.diff(e) > 0).all() and e[0] >= 0 and e[-1] < n
+    # padded data row is the sentinel fill
+    dp = np.asarray(gindex.data_padded)
+    assert dp.shape == (n + 1, x.shape[1])
+    assert (dp[n] == np.float32(1e15)).all()
+    np.testing.assert_array_equal(dp[:n], x)
+
+
+def test_graph_connected_from_entries(dataset, gindex):
+    """Every row must be reachable from the seeded entries (else it can
+    never be returned at any beam width) — the symmetrized + back-filled
+    build keeps this small-world graph one component."""
+    n = dataset[0].shape[0]
+    adj = np.asarray(gindex.storage.adjacency)[:n]
+    seen = np.zeros(n, bool)
+    frontier = list(np.asarray(gindex.storage.entries))
+    seen[frontier] = True
+    while frontier:
+        nxt = adj[frontier].ravel()
+        nxt = nxt[(nxt >= 0) & ~seen[nxt]]
+        seen[nxt] = True
+        frontier = list(np.unique(nxt))
+    assert seen.all(), f"{(~seen).sum()} rows unreachable from entries"
+
+
+def test_tiny_n_clamps_degree():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    idx = graph_build(x, GraphParams(degree=16, seed=0))
+    assert idx.storage.degree == 2          # clamped to n - 1
+    d, i = graph_search(idx, x[:2], 2, beam=2)
+    # exact at this scale: nearest is the row itself
+    assert (np.asarray(i)[:, 0] == np.arange(2)).all()
+    assert np.asarray(d)[0, 0] == 0.0
+
+
+# -- search ------------------------------------------------------------------
+
+
+def test_beam_recall_vs_oracle(dataset, gindex):
+    x, q = dataset
+    oracle = np_knn_ids(x, q, 10)
+    d, i = graph_search(gindex, q, 10, beam=32)
+    assert recall(np.asarray(i), oracle) >= 0.95
+    # distances are exact f32 L2 of the returned ids
+    dn = np.asarray(d)
+    ref = np.linalg.norm(
+        q[:, None, :] - x[np.asarray(i)], axis=-1
+    ).astype(np.float32)
+    # gram-form f32 (||q||^2 + ||x||^2 - 2qx) vs float64 diff-norm:
+    # cancellation leaves ~1e-5 absolute on the squared scale
+    np.testing.assert_allclose(dn, ref, rtol=1e-4, atol=1e-3)
+    # and sorted ascending per query
+    assert (np.diff(dn, axis=1) >= -1e-6).all()
+
+
+def test_rerank_tail_bit_identity_saturated_pool():
+    """On an integer grid (exactly representable f32 arithmetic), the
+    returned squared distances must be BITWISE what the shared rerank
+    authority scores for those ids — the beam program's tail IS
+    score_l2_candidates, not a reimplementation."""
+    from raft_tpu.spatial.ann.common import score_l2_candidates
+
+    rng = np.random.default_rng(11)
+    x = rng.integers(-64, 64, size=(256, 8)).astype(np.float32)
+    q = rng.integers(-64, 64, size=(6, 8)).astype(np.float32)
+    idx = graph_build(x, GraphParams(degree=8, seed=0),
+                      metric="sqeuclidean")
+    d, i = graph_search(idx, q, 8, beam=16)
+    ids = np.asarray(i)
+    assert (ids >= 0).all()                  # saturated: full k found
+    ref = np.asarray(score_l2_candidates(
+        jnp.asarray(q), jnp.asarray(x[ids]),
+        jnp.ones(ids.shape, bool),
+    ))
+    np.testing.assert_array_equal(np.asarray(d), ref)
+
+
+def test_pallas_interpret_matches_lax_engine(dataset, gindex):
+    """The kernel-engine search (interpret mode on CPU) must agree with
+    the XLA engine — the sub-chunk-min select + exact-subset rerank is
+    lossless w.r.t. the pool merge (the top-P cover argument)."""
+    x, q = dataset
+    kw = dict(k=10, beam=16, iters=12, hash_bits=14)
+    d0, i0 = graph_search(gindex, q, use_pallas=False, **kw)
+    d1, i1 = graph_search(gindex, q, use_pallas=True,
+                          pallas_interpret=True, **kw)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_beam_kernel_matches_lax_mirror_bitwise():
+    """graph_kernel sub-chunk minima: interpret-mode Pallas vs the lax
+    mirror, bitwise, on an integer grid (the flat/pq kernel discipline:
+    both paths take the same bf16 casts, so exactness is checkable)."""
+    from raft_tpu.spatial.ann import graph_kernel as gk
+
+    rng = np.random.default_rng(5)
+    nq, d, cp = 4, 16, 256
+    qp = gk.pad_queries(1)
+    qrows = np.zeros((nq, qp, d), np.float32)
+    qrows[:, 0, :] = rng.integers(-8, 8, size=(nq, d))
+    cands = rng.integers(-8, 8, size=(nq, d, cp)).astype(np.float32)
+    bounds = np.broadcast_to(np.array([0, cp], np.int32), (nq, 2))
+    a = gk.beam_scan_subchunk_min(
+        jnp.asarray(qrows), jnp.asarray(cands), jnp.asarray(bounds),
+        interpret=True,
+    )
+    b = gk.beam_scan_subchunk_min_lax(
+        jnp.asarray(qrows), jnp.asarray(cands), jnp.asarray(bounds)
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_search_arg_validation(dataset, gindex):
+    x, q = dataset
+    with pytest.raises(ValueError):
+        graph_search(gindex, q, 0)
+    with pytest.raises(ValueError):
+        graph_search(gindex, q, x.shape[0] + 1)
+    with pytest.raises(ValueError):
+        graph_search(gindex, q, 5, beam=0)
+    with pytest.raises(ValueError, match="dims differ"):
+        graph_search(gindex, q[:, :4], 5)
+
+
+# -- mutation (tombstones) ---------------------------------------------------
+
+
+def test_tombstone_delete_restore_parity(dataset, gindex):
+    x, q = dataset
+    oracle = np_knn_ids(x, q, 10)
+    dead = np.unique(oracle[:, 0])           # every query's top-1
+    mask = graph_delete(graph_live_mask(gindex), dead)
+    _, i_del = graph_search(gindex, q, 10, beam=32, row_mask=mask)
+    ids = np.asarray(i_del)
+    assert not (np.isin(ids, dead)).any(), \
+        "tombstoned rows must never be returned"
+    # parity vs the oracle over the LIVE rows only
+    live_rows = np.setdiff1d(np.arange(x.shape[0]), dead)
+    o_live = live_rows[np_knn_ids(x[live_rows], q, 10)]
+    assert recall(ids, o_live) >= 0.95
+    # restore: back to the unmasked answer
+    mask = graph_restore(mask, dead)
+    d_r, i_r = graph_search(gindex, q, 10, beam=32, row_mask=mask)
+    d_0, i_0 = graph_search(gindex, q, 10, beam=32)
+    np.testing.assert_array_equal(np.asarray(i_r), np.asarray(i_0))
+    np.testing.assert_array_equal(np.asarray(d_r), np.asarray(d_0))
+
+
+def test_mask_flips_zero_retrace(dataset, gindex):
+    """The graph_beam contract's claim, re-proven in-process: tombstone
+    VALUE flips reuse the warmed program (the mask is a runtime
+    operand); only the None <-> array signature change is a second
+    program, and warmup covers each."""
+    x, q = dataset
+    it = gindex.warmup(q.shape[0], k=10, beam=16, with_mask=True)
+    size0 = _beam_impl._cache_size()
+    mask = graph_live_mask(gindex)
+    for dead in ((3,), (3, 5), ()):
+        m = graph_delete(mask, np.asarray(dead, np.int64)) \
+            if dead else mask
+        graph_search(gindex, q, 10, beam=16, iters=it, row_mask=m)
+    assert _beam_impl._cache_size() == size0, \
+        "tombstone flips must not retrace the beam program"
+
+
+def test_warmup_audit_passes(dataset, gindex):
+    _, q = dataset
+    it = gindex.warmup(q.shape[0], k=10, beam=16, audit=True)
+    assert isinstance(it, int) and it >= 4
+    size0 = _beam_impl._cache_size()
+    graph_search(gindex, q, 10, beam=16, iters=it)
+    assert _beam_impl._cache_size() == size0
+
+
+# -- serving placement -------------------------------------------------------
+
+
+def test_place_index_replicates_whole(dataset, gindex):
+    from raft_tpu.comms import build_comms
+    from raft_tpu.comms.mnmg_ivf import place_index
+
+    comms = build_comms(jax.devices()[:8])
+    placed = place_index(comms, gindex)
+    # no sharded fields: the whole index replicates, searches bitwise
+    x, q = dataset
+    d0, i0 = graph_search(gindex, q, 10, beam=16, iters=10)
+    d1, i1 = graph_search(placed, q, 10, beam=16, iters=10)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    with pytest.raises(ValueError, match="replicates whole"):
+        place_index(comms, gindex, replication=2)
+
+
+# -- serialization -----------------------------------------------------------
+
+
+def test_serialize_roundtrip_bitwise(tmp_path, dataset, gindex):
+    import json
+
+    x, q = dataset
+    p = tmp_path / "graph.npz"
+    save_index(gindex, p)
+    with np.load(p) as npz:
+        header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
+    assert header["type"] == "graph" and header["version"] == 5
+    loaded = load_index(p)
+    assert loaded.metric == gindex.metric
+    np.testing.assert_array_equal(np.asarray(loaded.storage.adjacency),
+                                  np.asarray(gindex.storage.adjacency))
+    np.testing.assert_array_equal(np.asarray(loaded.storage.entries),
+                                  np.asarray(gindex.storage.entries))
+    np.testing.assert_array_equal(np.asarray(loaded.data_padded),
+                                  np.asarray(gindex.data_padded))
+    d0, i0 = graph_search(gindex, q, 5, beam=16, iters=10)
+    d1, i1 = graph_search(loaded, q, 5, beam=16, iters=10)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_serialize_corruption_names_field(tmp_path, gindex):
+    from raft_tpu.testing import faults
+
+    p = tmp_path / "graph.npz"
+    save_index(gindex, p)
+    damaged = faults.corrupt_bytes(p, field="storage.adjacency", seed=2)
+    assert damaged == "storage.adjacency"
+    with pytest.raises(errors.CorruptIndexError,
+                       match="storage.adjacency") as ei:
+        load_index(p)
+    assert ei.value.field == "storage.adjacency"
+
+
+# -- platform discipline -----------------------------------------------------
+
+
+def test_cpu_default_never_imports_kernel_modules():
+    """A fresh JAX_PLATFORMS=cpu process building + searching a graph
+    index on defaults must not import the beam kernel module (nor drag
+    in scan_core through it) — the kernel is an explicit opt-in."""
+    prog = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import numpy as np\n"
+        "from raft_tpu.spatial.ann import GraphParams, graph_build, "
+        "graph_search\n"
+        "rng = np.random.default_rng(0)\n"
+        "x = rng.standard_normal((300, 8)).astype(np.float32)\n"
+        "idx = graph_build(x, GraphParams(degree=8, seed=0))\n"
+        "it = idx.warmup(8, k=3, beam=8)\n"
+        "graph_search(idx, x[:8], 3, beam=8, iters=it)\n"
+        "for mod in ('raft_tpu.spatial.ann.graph_kernel',\n"
+        "            'raft_tpu.spatial.ann.scan_core'):\n"
+        "    assert mod not in sys.modules, \\\n"
+        "        f'CPU default graph search imported {mod}'\n"
+        "print('OK')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK" in out.stdout

@@ -381,13 +381,42 @@ def test_serving_latency_rows_tiny_config():
         zipf=False,       # the zipf_hot_traffic row has its own smoke
         cold_tier=False,  # (tests/test_result_cache.py); the cold_tier
         self_heal=False,  # row's smoke lives in tests/test_tier.py, the
-    )                     # self_heal row's in tests/test_chaos.py
+        graph=False,      # self_heal row's in tests/test_chaos.py, the
+    )                     # graph_ann row's below
     assert out["unit"] == "ms"
     assert [r["nq"] for r in out["rows"]] == [1, 4]
     for r in out["rows"]:
         assert r["engine"] == "ivf_flat"
         assert ("p50_ms" in r) or ("error" in r)
         assert "qcap" in r
+
+
+def test_graph_ann_row_tiny_config():
+    """The graph-ANN row on a tiny CPU config (docs/graph_ann.md
+    "Bench"): both arms must produce p50 + recall stamps, the served
+    beam/degree/iters must be stamped, and the beam sweep must land
+    recall within the 0.01 acceptance band of the in-row IVF baseline
+    (p50 ordering itself is hardware territory — the CPU drive proves
+    the measurement, not the win)."""
+    from bench.bench_serving import graph_ann_row
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((4096, 8)).astype(np.float32)
+    q = x[::17][:64] + 0.05 * rng.standard_normal((64, 8)).astype(
+        np.float32
+    )
+    idx = ivf_flat_build(x, IVFFlatParams(n_lists=8, kmeans_n_iters=3,
+                                          seed=2))
+    row = graph_ann_row(x, q, idx, k=4, n_probes=4, degree=8,
+                        beams=(8, 16, 32), n_recall_q=32,
+                        chain=(1, 3), escalate=0)
+    assert row["scenario"] == "graph_ann" and row["engine"] == "graph"
+    assert row["nq"] == 1
+    assert row["degree"] == 8 and row["beam"] in (8, 16, 32)
+    assert isinstance(row["iters"], int) and row["iters"] >= 4
+    assert ("p50_ms" in row) or ("error" in row)
+    assert "ivf_recall_at_10" in row and "recall_at_10" in row
+    assert row["recall_at_10"] >= row["ivf_recall_at_10"] - 0.01
 
 
 def test_serving_resilience_rows_tiny_config():
@@ -1442,5 +1471,86 @@ def test_round18_bench_line_parses_with_self_heal():
     for key in ("route_pushes", "heals_ok", "transitions",
                 "all_serving", "rate_rps", "gen_lag_ms",
                 "p99_ms_healthy", "p99_ms_healed"):
+        assert key in benchtop._PRINT_KEYS
+        assert key in benchtop._TRIM_ORDER
+
+
+def test_round19_bench_line_parses_with_graph_ann():
+    """ISSUE 19 satellite (the _fit_line parse/cap test extended,
+    following the r05-r18 pattern): the round-19 artifact shape — every
+    prior row PLUS the ``graph_ann`` row (one-dispatch beam search vs
+    the in-row IVF-Flat qcap-1 baseline, docs/graph_ann.md) — must
+    print as a line that json.loads-round-trips under the 1800-char
+    driver cap, with the acceptance stamps (``p50_ms``,
+    ``recall_at_10``, ``ivf_p50_ms``, ``ivf_recall_at_10``, ``beam``,
+    ``degree``, ``iters``) untrimmable."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r19", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01}
+        for i in range(8)
+    ] + [
+        # the round-18 self-heal row, unchanged
+        {"metric": "self_heal_ivf_flat_500000x96", "unit": "ms",
+         "scenario": "self_heal", "engine": "ivf_flat", "nq": 8,
+         "rate_rps": 210.0, "detection_ms": 112.4,
+         "route_convergence_ms": 113.0, "reintegration_ms": 41.7,
+         "p99_ms_healthy": 9.8, "p99_ms_degraded": 14.2,
+         "p99_ms_healed": 10.1, "healed_p99_x": 1.03,
+         "route_pushes": 3, "heals_ok": 1, "transitions": 2,
+         "all_serving": True, "gen_lag_ms": 4.4,
+         "spread": 0.03, "repeats": 5, "vs_prev": 1.0},
+        # the round-19 graph-ANN row under test
+        {"metric": "graph_ann_500000x96", "unit": "ms",
+         "scenario": "graph_ann", "engine": "graph", "nq": 1,
+         "degree": 16, "beam": 32, "iters": 23,
+         "p50_ms": 0.41, "recall_at_10": 0.961, "spread": 0.04,
+         "repeats": 5, "ivf_p50_ms": 1.38, "ivf_recall_at_10": 0.958,
+         "ivf_qcap": 8, "ivf_spread": 0.05, "vs_prev": 1.0},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "program_audit_ms": 34193.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # on a roomy line the row prints whole, acceptance stamps included
+    small = benchtop._fit_line({
+        "metric": "graph_ann_500000x96", "unit": "ms",
+        "p50_ms": 0.41, "recall_at_10": 0.961, "ivf_p50_ms": 1.38,
+        "ivf_recall_at_10": 0.958, "beam": 32, "degree": 16,
+        "iters": 23, "extras": [],
+    })
+    small_parsed = json.loads(small)
+    assert small_parsed["p50_ms"] == 0.41
+    assert small_parsed["ivf_p50_ms"] == 1.38
+    assert small_parsed["beam"] == 32
+    assert small_parsed["iters"] == 23
+    # the acceptance evidence is untrimmable; the secondaries trim
+    for key in ("p50_ms", "recall_at_10", "ivf_p50_ms",
+                "ivf_recall_at_10", "beam", "degree", "iters"):
+        assert key in benchtop._PRINT_KEYS
+        assert key not in benchtop._TRIM_ORDER
+    for key in ("ivf_qcap", "ivf_spread"):
         assert key in benchtop._PRINT_KEYS
         assert key in benchtop._TRIM_ORDER
