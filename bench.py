@@ -1374,6 +1374,13 @@ _PRINT_KEYS = {
     # are the acceptance, beam/degree/iters the served config
     "ivf_p50_ms", "ivf_recall_at_10", "beam", "degree", "iters",
     "ivf_qcap", "ivf_spread",
+    # the durable-WAL ingest row (ISSUE 20, docs/robustness.md
+    # "Durability"): acked-ingest QPS with fsync-durable acks vs the
+    # non-durable apply — durability_ratio is the >= ~0.8 acceptance,
+    # fsync_interval_ms/fsync_p50_ms/wal_mb_per_s the commit-path
+    # evidence
+    "durable_qps", "nondurable_qps", "durability_ratio",
+    "fsync_interval_ms", "fsync_p50_ms", "wal_mb_per_s",
 }
 
 
@@ -1412,6 +1419,10 @@ _TRIM_ORDER = (
     # ivf_p50_ms / ivf_recall_at_10 / beam / degree / iters are
     # acceptance evidence and stay untrimmable
     "ivf_spread", "ivf_qcap",
+    # durable_ingest secondaries fall first; durable_qps /
+    # nondurable_qps / durability_ratio are acceptance evidence and
+    # stay untrimmable
+    "fsync_interval_ms", "fsync_p50_ms", "wal_mb_per_s",
     "p50_ms_50", "p50_ms_80", "shed_rate_95", "p99_ms_50",
     "upsert_visible_ms", "delete_masked_ms", "ingest_qps", "frozen_qps",
     "merge_ms_flat", "merge_ms_hier", "wire", "dcn_bytes_per_query",

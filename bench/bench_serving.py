@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 
@@ -359,6 +360,110 @@ def mixed_ingest_row(idx, qb, *, k: int = 10, n_probes: int = 16,
     del_ms = (time.perf_counter() - t0) * 1e3
     if bool(found[0]) and int(vis_ids[0]) not in np.asarray(iv2)[0].tolist():
         row["delete_masked_ms"] = round(del_ms, 3)
+    return row
+
+
+def durable_ingest_row(idx, qb, *, ingest_batch: int = 128,
+                       n_batches: int = 24, delta_cap: int = 64,
+                       fsync_intervals_ms=(0.0, 2.0)) -> dict:
+    """The durable-WAL ingest row (ISSUE 20, docs/robustness.md
+    "Durability"): acked-ingest QPS through
+    :class:`raft_tpu.durability.wal.DurableIngest` (journal + apply +
+    fsync-durable ack) next to the non-durable arm (the same jitted
+    apply with a host sync per batch, no journal) — so
+    ``durability_ratio`` prices exactly the WAL tax: encode + group
+    commit + fsync wait. Acceptance >= ~0.8.
+
+    ``fsync_intervals_ms`` sweeps the group-commit flush interval (0 =
+    byte/immediate-triggered); the stamped primary
+    ``durable_qps``/``fsync_interval_ms``/``fsync_p50_ms``/
+    ``wal_mb_per_s`` come from the best interval, the full sweep rides
+    in ``fsync_sweep`` (bench_full.json only). The WAL lives in a temp
+    dir torn down with the row; every batch uses fresh ids, and a
+    saturated delta rejects through the identical program in BOTH arms,
+    so the quotient stays fair."""
+    import tempfile
+
+    from raft_tpu.durability import wal as wal_mod
+    from raft_tpu.spatial.ann.mutation import (
+        upsert as mut_upsert, wrap_mutable,
+    )
+
+    nq, d = qb.shape
+    vb0 = np.asarray(
+        jnp.tile(qb, (-(-ingest_batch // nq), 1))[:ingest_batch],
+        np.float32,
+    )
+    row = {
+        "engine": "ivf_flat", "scenario": "durable_ingest",
+        "ingest_batch": int(ingest_batch), "n_batches": int(n_batches),
+    }
+
+    def batches(base):
+        for b in range(n_batches):
+            ids = np.arange(base + b * ingest_batch,
+                            base + (b + 1) * ingest_batch, dtype=np.int32)
+            yield vb0 * (1.0 + 1e-6 * (b + 1)), ids
+
+    # non-durable arm: the same apply program, host-synced per batch
+    # (the ack semantics minus durability — acc realized = batch landed)
+    mw = wrap_mutable(idx, delta_cap=delta_cap)
+    _, warm_acc = mut_upsert(mw, vb0, np.arange(ingest_batch,
+                                                dtype=np.int32))
+    np.asarray(warm_acc)                         # compile + warm
+    mw = wrap_mutable(idx, delta_cap=delta_cap)
+    t0 = time.perf_counter()
+    for vb, ids in batches(30_000_000):
+        mw, acc = mut_upsert(mw, vb, ids)
+        np.asarray(acc)
+    nd_s = time.perf_counter() - t0
+    row["nondurable_qps"] = round(n_batches * ingest_batch / nd_s, 1)
+
+    # durable arm, one run per swept fsync interval: WAL-first apply
+    # with the ack resolved only after the group commit's fsync
+    sweep = []
+    for iv_ms in fsync_intervals_ms:
+        fsync_ms = []
+
+        def timed_fsync(fd, _lat=fsync_ms):
+            t = time.perf_counter()
+            os.fsync(fd)
+            _lat.append((time.perf_counter() - t) * 1e3)
+
+        with tempfile.TemporaryDirectory() as td:
+            w = wal_mod.WalWriter(
+                td, flush_interval_s=iv_ms / 1e3, name="bench-wal",
+                fsync=timed_fsync,
+            )
+            ing = wal_mod.DurableIngest(
+                wrap_mutable(idx, delta_cap=delta_cap), w)
+            ing.upsert(vb0, np.arange(ingest_batch, dtype=np.int32))
+            fsync_ms.clear()
+            t0 = time.perf_counter()
+            for vb, ids in batches(40_000_000):
+                ing.upsert(vb, ids)
+            du_s = time.perf_counter() - t0
+            wal_bytes = sum(
+                os.path.getsize(s)
+                for s in wal_mod.segment_paths(td))
+            ing.close()
+        sweep.append({
+            "fsync_interval_ms": float(iv_ms),
+            "durable_qps": round(n_batches * ingest_batch / du_s, 1),
+            "fsync_p50_ms": round(
+                float(np.median(fsync_ms)), 4) if fsync_ms else 0.0,
+            "n_fsyncs": len(fsync_ms),
+            "wal_mb_per_s": round(wal_bytes / du_s / 1e6, 2),
+        })
+
+    best = max(sweep, key=lambda s: s["durable_qps"])
+    row.update({k: best[k] for k in (
+        "durable_qps", "fsync_interval_ms", "fsync_p50_ms",
+        "wal_mb_per_s",
+    )})
+    row["durability_ratio"] = round(
+        row["durable_qps"] / row["nondurable_qps"], 3)
+    row["fsync_sweep"] = sweep
     return row
 
 
@@ -1304,7 +1409,7 @@ def serving_latency_rows(
     chain=(4, 32), escalate: int = 2,
     hedged: bool = True, overload: bool = True, mixed: bool = True,
     open_loop: bool = True, zipf: bool = True, cold_tier: bool = True,
-    self_heal: bool = True, graph: bool = True,
+    self_heal: bool = True, graph: bool = True, durable: bool = True,
 ):
     """One latency row per (engine, nq): ``{"engine", "nq", "p50_ms",
     "spread", "repeats", "qcap"?}`` (``"error"`` on a failed point so one
@@ -1570,6 +1675,22 @@ def serving_latency_rows(
         except Exception as e:                       # noqa: BLE001
             rows.append({
                 "engine": "ivf_flat", "scenario": "mixed_ingest",
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+
+    # the durable-WAL ingest row (ISSUE 20, docs/robustness.md
+    # "Durability"): acked-ingest QPS vs fsync interval, WAL tax
+    # priced against the non-durable apply (durability_ratio >= ~0.8)
+    if durable and "ivf_flat" in engines:
+        try:
+            nq_m = min(128, max(nqs))
+            rows.append(durable_ingest_row(
+                get_index("ivf_flat"), qall[:nq_m],
+                ingest_batch=min(128, max(8, nq_m)),
+            ))
+        except Exception as e:                       # noqa: BLE001
+            rows.append({
+                "engine": "ivf_flat", "scenario": "durable_ingest",
                 "error": f"{type(e).__name__}: {e}"[:160],
             })
     return {

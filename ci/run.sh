@@ -145,6 +145,18 @@ run_chaos() {
         python -m pytest tests/test_chaos.py -q -x
 }
 
+run_wal() {
+    # Durable-WAL crash-recovery smoke (ISSUE 20, docs/robustness.md
+    # "Durability"): frame/torn-tail/group-commit/recovery contracts
+    # plus the >=10-point kill -9 gate (the @slow test tier-1 skips),
+    # under RAFT_TPU_LOCKCHECK=1 so the writer/flusher/ingest lock
+    # interleavings are order-checked while real SIGKILLs land.
+    echo "== durable WAL crash recovery (tests/test_wal.py, lockcheck on) =="
+    RAFT_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_wal.py -q -x
+}
+
 run_multihost_smoke() {
     # CPU-only 2-process host-sim smoke (ISSUE 9): the multiproc
     # rendezvous workers build the (num_procs, 2) HierarchicalComms
@@ -186,11 +198,12 @@ case "$stage" in
     tier) run_tier_smoke ;;
     graph) run_graph_smoke ;;
     chaos) run_chaos ;;
+    wal) run_wal ;;
     multihost) run_multihost_smoke ;;
     all) run_style; run_programs; run_threads; run_install_check; \
          run_docs; run_x64; run_tier_smoke; run_graph_smoke; \
-         run_chaos; run_multihost_smoke; run_tests ;;
-    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|tier|graph|chaos|multihost|all)"
+         run_chaos; run_wal; run_multihost_smoke; run_tests ;;
+    *) echo "unknown stage: $stage (style|programs|threads|test|x64|docs|tier|graph|chaos|wal|multihost|all)"
        exit 2 ;;
 esac
 echo "CI: OK"

@@ -16,7 +16,10 @@ the tier-1 ``style`` stage is unchanged):
   ``wait`` parks the thread but releases only its own lock),
   ``Event.wait``, ``Future.result``, and ``Thread.join`` while holding
   a lock — each parks a thread that other threads may need the held
-  lock to wake;
+  lock to wake; plus the durable-IO calls — ``os.fsync`` /
+  ``os.fdatasync`` / ``.flush()`` — which park the holder behind the
+  DISK (the WAL group-commit contract: acks are taken under the lock,
+  the fsync batch runs outside it, docs/robustness.md "Durability");
 * ``sleep-under-lock`` — ``time.sleep`` while holding a lock
   serializes every contender behind a timer.
 
@@ -108,7 +111,8 @@ class BlockingCallUnderLock(Rule):
     name = "blocking-call-under-lock"
     description = (
         "Condition.wait on a foreign lock, Event.wait, Future.result, "
-        "or Thread.join while holding a lock"
+        "Thread.join, or durable IO (os.fsync/os.fdatasync/.flush) "
+        "while holding a lock"
     )
 
     def check(self, ctx) -> Iterator:
@@ -145,6 +149,23 @@ class BlockingCallUnderLock(Rule):
                             f"Thread.join() while holding "
                             f"{self._chain(held)} in {method}()",
                         )
+                elif tail in ("fsync", "fdatasync"):
+                    callee = ctx.facts.callee(node)
+                    if callee in ("os.fsync", "os.fdatasync"):
+                        yield ctx.finding(
+                            self.name, node,
+                            f"{callee}() while holding "
+                            f"{self._chain(held)} in {method}() — "
+                            "fsync outside the lock, publish the "
+                            "durable LSN under it",
+                        )
+                elif tail == "flush":
+                    yield ctx.finding(
+                        self.name, node,
+                        f".flush() while holding {self._chain(held)} "
+                        f"in {method}() — the holder parks behind "
+                        "the disk",
+                    )
 
     def _check_wait(self, ctx, census, node, method, recv_attr, held):
         if recv_attr in census.event_attrs:

@@ -51,10 +51,12 @@ from raft_tpu.comms.multihost import (
     host_rank_mask,
 )
 from raft_tpu.comms.mnmg_mutation import (
+    MnmgDurableIngest,
     MnmgMutableIndex,
     MnmgMutationState,
     mnmg_delete,
     mnmg_mutable_search,
+    mnmg_recover,
     mnmg_upsert,
     resync_rank,
     wrap_mnmg_mutable,
@@ -98,12 +100,14 @@ __all__ = [
     "replicate_index",
     "reshard_index",
     "shard_rows",
+    "MnmgDurableIngest",
     "MnmgMutableIndex",
     "MnmgMutationState",
     "wrap_mnmg_mutable",
     "mnmg_upsert",
     "mnmg_delete",
     "mnmg_mutable_search",
+    "mnmg_recover",
     "resync_rank",
     "ring_knn",
     "ring_pairwise_distance",

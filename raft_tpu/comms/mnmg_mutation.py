@@ -28,11 +28,24 @@ Compaction at MNMG scale is the rebuild/reshard path: drain the deltas
 through ``mnmg_*_build_distributed`` (or restore + re-place a compacted
 checkpoint); the delta capacity budget should cover the ingest expected
 between rebuilds (docs/mutation.md "Capacity tuning").
+
+Durability (docs/robustness.md "Durability"):
+:class:`MnmgDurableIngest` fronts the write path with one
+:class:`~raft_tpu.durability.wal.WalWriter` per rank under a shared
+root, a coordinator-assigned GLOBAL LSN stream, and quorum acks — a
+row is acked only when its batch's frame is fsync-durable on the
+row's primary holder AND a quorum of its live replica holders.
+:func:`mnmg_recover` repairs every rank's torn tail, takes the UNION
+of the per-rank logs (monotone-LSN dedupe — each batch replays once
+however many holders journaled it), and replays in LSN order, which
+reconciles lagging ranks' frontiers: a rank that crashed before its
+fsync is healed by any holder that got the frame down.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import typing
 
 import jax
@@ -41,16 +54,20 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu import compat, errors
+from raft_tpu.analysis.threads import runtime as lockcheck
 from raft_tpu.cluster.kmeans import kmeans_predict
 from raft_tpu.comms.comms import Comms
+from raft_tpu.durability import wal as _wal
 from raft_tpu.resilience.degraded import resolve_shard_mask
 from raft_tpu.resilience.replica import ReplicaPlacement
 
 __all__ = [
+    "MnmgDurableIngest",
     "MnmgMutationState",
     "MnmgMutableIndex",
     "mnmg_delete",
     "mnmg_mutable_search",
+    "mnmg_recover",
     "mnmg_upsert",
     "resync_rank",
     "wrap_mnmg_mutable",
@@ -371,3 +388,225 @@ def mnmg_mutable_search(comms: Comms, mindex: MnmgMutableIndex, queries,
     return mnmg_ivf_flat_search(
         comms, mindex.index, queries, k, mutation=mindex.state, **kw
     )
+
+
+# ----------------------------------------------------------- durability
+def _rank_wal_dir(root, rank: int) -> str:
+    return os.path.join(root, f"rank-{rank:02d}")
+
+
+def _row_holders(index, placement, vecs: np.ndarray) -> np.ndarray:
+    """(B, R) holder ranks per row (owner first, then replicas;
+    -1 = unowned centroid) — the durability-quorum membership."""
+    R, off = placement.replication, placement.offset
+    Pn = int(index.sorted_ids.shape[0])
+    owner = np.asarray(index.owner)
+    lbl = np.asarray(kmeans_predict(
+        jnp.asarray(vecs), jnp.asarray(index.centroids, jnp.float32)
+    )).astype(np.int64)
+    own = owner[lbl]
+    holders = np.full((vecs.shape[0], placement.replication), -1,
+                      np.int64)
+    for j in range(R):
+        holders[:, j] = np.where(own >= 0, (own + j * off) % Pn, -1)
+    return holders
+
+
+class MnmgDurableIngest:
+    """Per-rank WAL + quorum-acked ingest for a sharded mutable index.
+
+    One :class:`~raft_tpu.durability.wal.WalWriter` per rank under
+    ``wal_root/rank-XX``; the coordinator assigns one GLOBAL LSN per
+    batch and journals the batch on every LIVE holder rank it touches
+    (per-rank logs are sparse — gaps are fine, replay is monotone).
+    A row's ack then requires its frame fsync-durable on the row's
+    PRIMARY holder (first live holder, the rank that serves it) and on
+    at least ``quorum`` of its remaining live replica holders
+    (default: all of them — matching :func:`mnmg_upsert`'s
+    every-live-holder acceptance); a rank whose WAL has failed simply
+    stops contributing to quorums, the mutation-tier analog of a dead
+    shard. Recovery is :func:`mnmg_recover`. Host-side control plane
+    only — the serving read path is untouched."""
+
+    def __init__(self, comms: Comms, mindex: MnmgMutableIndex,
+                 wal_root, *, quorum: typing.Optional[int] = None,
+                 name: str = "mnmg-wal", flight=None, **wal_kw):
+        R = mindex.placement.replication
+        self._quorum = (R - 1) if quorum is None else int(quorum)
+        errors.expects(
+            0 <= self._quorum <= R - 1,
+            "MnmgDurableIngest: quorum=%d outside [0, R-1=%d]",
+            self._quorum, R - 1,
+        )
+        self._comms = comms
+        self._mindex = mindex
+        self._name = name
+        self._flight = flight
+        self._lock = lockcheck.make_lock("MnmgDurableIngest._lock")
+        self._wals = {
+            r: _wal.WalWriter(
+                _rank_wal_dir(wal_root, r),
+                name=f"{name}-r{r:02d}", flight=flight, **wal_kw)
+            for r in range(comms.size)
+        }
+        frontier = max(w.durable_lsn for w in self._wals.values())
+        self._next_lsn = frontier + 1
+        self._applied_lsn = frontier
+
+    @property
+    def mindex(self) -> MnmgMutableIndex:
+        with self._lock:
+            return self._mindex
+
+    @property
+    def applied_lsn(self) -> int:
+        with self._lock:
+            return self._applied_lsn
+
+    def frontiers(self) -> dict:
+        """Per-rank durable LSN frontier — lagging ranks (a dead WAL, a
+        crash before fsync) show up here; :func:`mnmg_recover`
+        reconciles them from the union of the healthy logs."""
+        return {r: w.durable_lsn for r, w in self._wals.items()}
+
+    def _journal(self, ranks, op: int, payload: bytes, lsn: int):
+        """Append one frame to each rank's WAL; a rank whose writer
+        raises (failed disk, closed) is simply absent from the
+        returned ``{rank: ack}`` map — it can no longer hold quorum."""
+        acks = {}
+        for r in sorted(ranks):
+            try:
+                acks[r] = self._wals[r].append(
+                    op, payload, lsn=lsn, epoch=0)
+            except Exception:
+                continue
+        return acks
+
+    @staticmethod
+    def _durable_ranks(acks: dict, timeout_s: float = 30.0) -> set:
+        durable = set()
+        for r, ack in acks.items():
+            try:
+                if ack.wait(timeout_s):
+                    durable.add(r)
+            except Exception:
+                continue
+        return durable
+
+    def upsert(self, vectors, ids, *, alive=None) -> np.ndarray:
+        """Journal + apply one upsert batch; returns the ACK mask:
+        accepted by :func:`mnmg_upsert` AND fsync-durable on the
+        primary + quorum of live replica holders. A row applied but
+        not durably acked is NOT half-applied — recovery replays it
+        in full from whichever holder journaled it, or not at all;
+        the caller retries un-acked rows (idempotently — an upsert
+        supersedes its own previous copy)."""
+        vecs = np.ascontiguousarray(np.asarray(vectors, np.float32))
+        ids_np = np.asarray(ids, np.int32)
+        payload = _wal.encode_upsert(vecs, ids_np)
+        Pn = self._comms.size
+        alive_np = np.asarray(resolve_shard_mask(
+            True if alive is None else alive, Pn))
+        with self._lock:
+            holders = _row_holders(
+                self._mindex.index, self._mindex.placement, vecs)
+            involved = {
+                int(r) for r in np.unique(holders)
+                if r >= 0 and alive_np[int(r)]
+            }
+            lsn = self._next_lsn
+            self._next_lsn += 1
+            acks = self._journal(involved, _wal.OP_UPSERT, payload, lsn)
+            self._mindex, accepted = mnmg_upsert(
+                self._comms, self._mindex, vecs, ids_np, alive=alive_np)
+            self._applied_lsn = lsn
+        durable = self._durable_ranks(acks)
+        acked = np.asarray(accepted, bool).copy()
+        for i in np.nonzero(acked)[0]:
+            live_h = [int(r) for r in holders[i]
+                      if r >= 0 and alive_np[int(r)]]
+            if not live_h:
+                acked[i] = False
+                continue
+            need = min(1 + self._quorum, len(live_h))
+            n_dur = sum(1 for r in live_h if r in durable)
+            acked[i] = live_h[0] in durable and n_dur >= need
+        return acked
+
+    def delete(self, ids, *, alive=None) -> np.ndarray:
+        """Journal + apply one delete batch; returns ``found`` masked
+        by durability (a tombstone is acked only when journaled on a
+        quorum of live ranks — deletes touch every holder, so the
+        batch is journaled mesh-wide)."""
+        ids_np = np.asarray(ids, np.int32)
+        payload = _wal.encode_delete(ids_np)
+        Pn = self._comms.size
+        alive_np = np.asarray(resolve_shard_mask(
+            True if alive is None else alive, Pn))
+        live = [r for r in range(Pn) if alive_np[r]]
+        with self._lock:
+            lsn = self._next_lsn
+            self._next_lsn += 1
+            acks = self._journal(live, _wal.OP_DELETE, payload, lsn)
+            self._mindex, found = mnmg_delete(
+                self._comms, self._mindex, ids_np)
+            self._applied_lsn = lsn
+        durable = self._durable_ranks(acks)
+        need = min(1 + self._quorum, max(len(live), 1))
+        if len(durable) < need:
+            return np.zeros_like(np.asarray(found, bool))
+        return np.asarray(found, bool)
+
+    def close(self) -> None:
+        for w in self._wals.values():
+            try:
+                w.close()
+            except Exception:
+                continue
+
+
+def mnmg_recover(comms: Comms, mindex: MnmgMutableIndex, wal_root, *,
+                 start_lsn: int = 0, name: str = "mnmg-wal",
+                 flight=None):
+    """Fleet crash recovery: repair every rank's WAL tail, take the
+    UNION of the per-rank logs (monotone-LSN dedupe — a batch
+    journaled on three holders replays once), and replay in LSN order
+    onto ``mindex`` (the re-placed base state). The union reconciles
+    per-rank frontiers: a rank whose log stops early (crashed before
+    its fsync) is healed by any holder that got the frame down —
+    exactly the quorum the ack demanded. Returns ``(mindex,
+    frontiers, n_replayed)`` with the PRE-repair per-rank frontier
+    map for audit."""
+    frontiers = {}
+    union: dict = {}
+    for r in range(comms.size):
+        d = _rank_wal_dir(wal_root, r)
+        if not os.path.isdir(d):
+            frontiers[r] = 0
+            continue
+        records, frontier = _wal.repair_wal(
+            d, name=f"{name}-r{r:02d}", flight=flight)
+        frontiers[r] = frontier
+        for rec in records:
+            union.setdefault(rec.lsn, rec)
+    last = int(start_lsn)
+    n = 0
+    for lsn in sorted(union):
+        if lsn <= last:
+            continue
+        rec = union[lsn]
+        if rec.op == _wal.OP_UPSERT:
+            vecs, ids = _wal.decode_upsert(rec.payload)
+            mindex, _ = mnmg_upsert(comms, mindex, vecs, ids)
+        elif rec.op == _wal.OP_DELETE:
+            mindex, _ = mnmg_delete(
+                comms, mindex, _wal.decode_delete(rec.payload))
+        else:
+            raise errors.CorruptIndexError(
+                f"mnmg_recover: unknown op {rec.op} at lsn {rec.lsn}",
+                field="op",
+            )
+        last = lsn
+        n += 1
+    _wal.series(name)["replayed"].inc(n)
+    return mindex, frontiers, n

@@ -1,0 +1,56 @@
+"""Durability tier — crash-consistent ingest for the mutation path.
+
+The mutation tier's checkpoints (v4 delta + full v4/v5) bound what a
+crash loses to "everything since the last flush"; this package closes
+the window to "nothing acked" with a write-ahead log: CRC32-framed
+segments, host-side group commit (acks resolve only after fsync),
+rotation with retention pinned to the delta-checkpoint LSN watermark,
+torn-tail repair, and idempotent monotone-LSN replay. Recovery = load
+the latest checkpoint + replay the WAL tail (docs/robustness.md
+"Durability"). The sharded tier (per-rank WAL, quorum acks, frontier
+reconciliation) lives in :mod:`raft_tpu.comms.mnmg_mutation`.
+"""
+
+from raft_tpu.durability.wal import (
+    OP_DELETE,
+    OP_UPSERT,
+    WAL_VERSION,
+    DurableIngest,
+    WalAck,
+    WalRecord,
+    WalWriter,
+    decode_delete,
+    decode_upsert,
+    encode_delete,
+    encode_frame,
+    encode_upsert,
+    read_records,
+    recover_mutable,
+    repair_wal,
+    replay_into,
+    scan_segment,
+    segment_paths,
+    wal_frontier,
+)
+
+__all__ = [
+    "OP_DELETE",
+    "OP_UPSERT",
+    "WAL_VERSION",
+    "DurableIngest",
+    "WalAck",
+    "WalRecord",
+    "WalWriter",
+    "decode_delete",
+    "decode_upsert",
+    "encode_delete",
+    "encode_frame",
+    "encode_upsert",
+    "read_records",
+    "recover_mutable",
+    "repair_wal",
+    "replay_into",
+    "scan_segment",
+    "segment_paths",
+    "wal_frontier",
+]

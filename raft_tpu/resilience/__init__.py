@@ -78,6 +78,7 @@ from raft_tpu.resilience.replica import (
 )
 from raft_tpu.resilience.supervisor import (
     STATE_QUARANTINED,
+    STATE_RECOVERING,
     STATE_RESYNCING,
     STATE_SERVING,
     STATE_WARMING,
@@ -106,6 +107,7 @@ __all__ = [
     "HealActions",
     "STATE_SERVING",
     "STATE_QUARANTINED",
+    "STATE_RECOVERING",
     "STATE_RESYNCING",
     "STATE_WARMING",
     "FailoverPlan",

@@ -29,7 +29,8 @@ but nothing watches it):
   (pinned by the program contracts), so a push never recompiles —
   zero-retrace is audited in the chaos suite by compiled-cache size.
 - **Reintegrate.** A confirmed UP drives the heal pipeline as a
-  RESUMABLE per-rank state machine — QUARANTINED → RESYNCING (recover +
+  RESUMABLE per-rank state machine — QUARANTINED → RECOVERING (WAL
+  tail replay, docs/robustness.md "Durability") → RESYNCING (recover +
   resync) → WARMING (tier sync + program warm) → SERVING — each step
   under its own deadline with :class:`~raft_tpu.resilience.deadline.RetryPolicy`
   backoff; a step that exhausts its budget rolls the rank back to
@@ -74,30 +75,38 @@ __all__ = [
     "SupervisorStats",
     "STATE_SERVING",
     "STATE_QUARANTINED",
+    "STATE_RECOVERING",
     "STATE_RESYNCING",
     "STATE_WARMING",
 ]
 
 # the per-rank reintegration state machine: QUARANTINED is the routed-
-# around steady state of a down rank; RESYNCING covers the data-plane
-# splice (checkpoint recover + mutation-delta resync); WARMING covers
-# bring-back validation (tier journal sync + program warm); SERVING is
-# healthy. Encoded in the supervisor_state gauge as 0/1/2/3.
+# around steady state of a down rank; RECOVERING covers durable-state
+# replay (WAL tail past the checkpoint watermark — docs/robustness.md
+# "Durability"); RESYNCING covers the data-plane splice (checkpoint
+# recover + mutation-delta resync); WARMING covers bring-back
+# validation (tier journal sync + program warm); SERVING is healthy.
+# Encoded in the supervisor_state gauge as 0/1/2/3/4.
 STATE_SERVING = "serving"
 STATE_QUARANTINED = "quarantined"
 STATE_RESYNCING = "resyncing"
 STATE_WARMING = "warming"
+STATE_RECOVERING = "recovering"
 _STATE_CODE = {
     STATE_SERVING: 0,
     STATE_QUARANTINED: 1,
     STATE_RESYNCING: 2,
     STATE_WARMING: 3,
+    STATE_RECOVERING: 4,
 }
 
 # the ordered heal pipeline; each step maps to the state the rank shows
 # while it runs. Steps with no configured action are skipped (and still
-# recorded as done, so resume semantics stay simple).
+# recorded as done, so resume semantics stay simple). WAL replay runs
+# FIRST: the rank's durable mutation state must be current before the
+# peer resync diffs against it (and before tier sync reads its epochs).
 _HEAL_STEPS: Tuple[Tuple[str, str], ...] = (
+    ("replay_wal", STATE_RECOVERING),
     ("recover", STATE_RESYNCING),
     ("resync", STATE_RESYNCING),
     ("sync_tier", STATE_WARMING),
@@ -109,7 +118,11 @@ _HEAL_STEPS: Tuple[Tuple[str, str], ...] = (
 class HealActions:
     """The reintegration actuators, injected so the supervisor stays
     decoupled from index specifics. Each is ``fn(rank) -> None`` (or
-    ``None`` to skip the step): ``recover`` splices the rank's main
+    ``None`` to skip the step): ``replay_wal`` replays the rank's
+    durable WAL tail past the checkpoint watermark
+    (:func:`~raft_tpu.durability.wal.recover_mutable` /
+    :func:`~raft_tpu.comms.mnmg_mutation.mnmg_recover` — runs FIRST,
+    under the RECOVERING state), ``recover`` splices the rank's main
     slabs back (:func:`~raft_tpu.comms.mnmg_ivf.recover_rank` from the
     latest checkpoint), ``resync`` catches its mutation state up from a
     donor replica (:func:`~raft_tpu.comms.mnmg_mutation.resync_rank`),
@@ -125,6 +138,7 @@ class HealActions:
     sync_tier: Optional[Callable[[int], None]] = None
     warm: Optional[Callable[[int], None]] = None
     rollback: Optional[Callable[[int], None]] = None
+    replay_wal: Optional[Callable[[int], None]] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -88,6 +88,7 @@ __all__ = [
     "compact",
     "compaction_stats",
     "delete",
+    "delta_checkpoint_watermark",
     "lists_changed_since",
     "mutable_search",
     "mutable_warmup",
@@ -135,6 +136,9 @@ def _mseries(index_name: str) -> dict:
                 },
                 "compactions": reg.counter("mutation_compactions_total",
                                            index=index_name),
+                "journal_compacted": reg.counter(
+                    "mutation_journal_compacted_total",
+                    index=index_name),
                 "fill": reg.gauge("mutation_delta_fill",
                                   index=index_name),
                 "max_fill": reg.gauge("mutation_delta_max_fill",
@@ -215,6 +219,10 @@ class MutableIndex:
         # everything, the safe direction). Host state only.
         self._epoch_journal: list = []
         self._journal_floor: int = 0
+        # optional host-side flight recorder (set at wrap/load time
+        # like ``name``; never serialized): where journal-compaction
+        # events land so a forced full tier refresh is attributable
+        self.flight = None
 
     @property
     def n_lists(self) -> int:
@@ -239,6 +247,7 @@ def _with(mindex: MutableIndex, **kw) -> MutableIndex:
     out.epoch = mindex.epoch
     out._epoch_journal = list(mindex._epoch_journal)
     out._journal_floor = mindex._journal_floor
+    out.flight = mindex.flight
     return out
 
 
@@ -258,6 +267,15 @@ def _journal_note(mindex: MutableIndex, changed) -> None:
         drop = len(j) - _EPOCH_JOURNAL_CAP
         mindex._journal_floor = j[drop - 1][0]
         del j[:drop]
+        # an overflow silently downgrades every reader below the new
+        # floor to "refresh everything" — count it + flight-mark it so
+        # a forced full resync is attributable (docs/observability.md)
+        _mseries(mindex.name)["journal_compacted"].inc(drop)
+        if mindex.flight is not None:
+            mindex.flight.record(
+                "mutation_journal_compacted", index=mindex.name,
+                dropped=drop, floor=mindex._journal_floor,
+                epoch=mindex.epoch)
 
 
 def lists_changed_since(mindex: MutableIndex, epoch: int):
@@ -1134,7 +1152,7 @@ _DELTA_VERSION = 4
 
 
 def save_delta_checkpoint(mindex: MutableIndex, path,
-                          *, lists=None) -> list:
+                          *, lists=None, wal_lsn=None) -> list:
     """Write an INCREMENTAL v4 checkpoint: only dirty lists' delta
     segments (``lists`` overrides the tracked dirty set), plus the small
     full ``row_mask``/``counts`` arrays, each CRC32-manifested like the
@@ -1143,7 +1161,15 @@ def save_delta_checkpoint(mindex: MutableIndex, path,
     stamps v4 for mutable payloads); replay newest-last with
     :func:`apply_delta_checkpoint`, which is idempotent — a duplicated
     flush re-applies to the same state. Clears the dirty set; returns
-    the list ids written."""
+    the list ids written.
+
+    ``wal_lsn`` (optional) stamps the durable-ingest watermark into the
+    header: the checkpoint captures every WAL record up to and
+    including that LSN, so recovery replays only the tail past it and
+    :meth:`raft_tpu.durability.wal.WalWriter.prune` may retire
+    segments behind it (docs/robustness.md "Durability"). Readable
+    back via :func:`delta_checkpoint_watermark`; absent on
+    non-durable-path checkpoints (older files load unchanged)."""
     from raft_tpu.spatial.ann.serialize import _array_crc
 
     ls = sorted(set(mindex.dirty_lists if lists is None else lists))
@@ -1170,6 +1196,7 @@ def save_delta_checkpoint(mindex: MutableIndex, path,
         "n_lists": int(di.shape[0]),
         "cap": int(delta.cap),
         "lists": [int(l) for l in ls],
+        **({} if wal_lsn is None else {"wal_lsn": int(wal_lsn)}),
         "integrity": {
             key: {
                 "crc32": _array_crc(arr),
@@ -1189,6 +1216,23 @@ def save_delta_checkpoint(mindex: MutableIndex, path,
         )
     mindex.dirty_lists.clear()
     return ls
+
+
+def delta_checkpoint_watermark(path):
+    """Read a delta checkpoint's ``wal_lsn`` watermark (the highest WAL
+    LSN the checkpoint captures) without loading its arrays — what
+    recovery uses to start the tail replay. ``None`` when the file
+    predates the durability tier or was written without a WAL."""
+    try:
+        with np.load(path) as npz:
+            header = json.loads(bytes(npz["__header__"]).decode("utf-8"))
+    except Exception as e:
+        raise errors.CorruptIndexError(
+            f"delta_checkpoint_watermark: header unreadable ({e})",
+            field="__header__",
+        ) from e
+    lsn = header.get("wal_lsn")
+    return None if lsn is None else int(lsn)
 
 
 def apply_delta_checkpoint(mindex: MutableIndex, path) -> MutableIndex:

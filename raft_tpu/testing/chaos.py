@@ -27,11 +27,26 @@ Three pieces compose:
   checker between them, and returns a :class:`ChaosReport` whose
   ``ok``/``violations`` the test asserts — the checker framework IS the
   assertion, not ad-hoc test code.
+
+The kill−9 leg (docs/robustness.md "Durability") goes one step harder
+than any in-process fault: :func:`run_crash_ingest_cycle` spawns a
+REAL subprocess (:mod:`raft_tpu.testing.crash_child`) that ingests
+through a :class:`~raft_tpu.durability.wal.WalWriter` and prints each
+ack strictly after its fsync, SIGKILLs it mid-ingest at a seeded
+point (no cleanup, no atexit, no flush — a power cut as seen from
+this host), then repairs + rereads the WAL so the test can assert
+zero acked records lost and zero torn frames applied. The
+:meth:`ChaosSchedule.kill9` composer scripts the same kill inside a
+timed schedule.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
+import subprocess
+import sys
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -54,6 +69,7 @@ __all__ = [
     "BoundInvariant",
     "ConvergenceInvariant",
     "inject_worker_crash",
+    "run_crash_ingest_cycle",
     "run_schedule",
 ]
 
@@ -256,6 +272,17 @@ class ChaosSchedule:
         ``times`` batches)."""
         return self.at(at_s, "crash_fetcher",
                        lambda: inject_worker_crash(store, times=times))
+
+    def kill9(self, at_s: float, proc) -> "ChaosSchedule":
+        """SIGKILL a subprocess at ``at_s`` — the whole-process crash
+        the WAL's durable-ack contract is proven against.  The victim
+        gets no cleanup, no ``atexit``, no final flush: exactly a
+        power cut as seen from this host.  ``proc`` is any object with
+        ``poll()``/``kill()`` (``subprocess.Popen``)."""
+        def fire() -> None:
+            if proc.poll() is None:
+                proc.kill()
+        return self.at(at_s, "kill9", fire)
 
 
 # ----------------------------------------------------------------------
@@ -468,3 +495,86 @@ def run_schedule(schedule: ChaosSchedule, *, duration_s: float,
     )
     return ChaosReport(fired=tuple(fired), violations=violations,
                        duration_s=final_s)
+
+
+# ----------------------------------------------------------------------
+# the kill-9 crash-ingest cycle
+
+
+def run_crash_ingest_cycle(wal_dir, *, kill_after_acks: int,
+                           n_records: int = 64, d: int = 8,
+                           seed: int = 0, flush_ms: float = 1.0,
+                           timeout_s: float = 120.0) -> Dict[str, object]:
+    """One seeded point of the kill−9 chaos gate: crash a real ingest
+    process mid-flight, recover, and report what survived.
+
+    Spawns :mod:`raft_tpu.testing.crash_child` (a subprocess that
+    appends ``n_records`` seeded single-row upserts through a
+    :class:`~raft_tpu.durability.wal.WalWriter` and prints
+    ``ACK <lsn> <id>`` strictly AFTER each record's fsync returned),
+    SIGKILLs it the moment the ``kill_after_acks``-th ack is read,
+    then repairs the torn WAL in THIS process and decodes every
+    surviving record.
+
+    Returns a dict the test asserts on:
+
+    * ``acked`` — ``[(lsn, id), ...]`` the child proved durable before
+      the kill; the contract is ``set(acked) <= set(recovered)``
+      (zero acked writes lost).
+    * ``recovered`` — ``[(lsn, id), ...]`` actually readable after
+      repair.  May exceed ``acked`` (records fsynced between the last
+      ack we read and the kill) but never ``submitted``; every entry
+      decoded from a CRC-clean frame, so nothing half-applied.
+    * ``frontier`` — highest contiguous durable LSN after repair.
+    * ``submitted`` — ``n_records``; ``returncode`` — the child's
+      (``-9`` when the kill landed, ``0`` if it finished first).
+
+    If ``kill_after_acks >= n_records`` the child simply completes —
+    the zero-fault leg of the same gate.
+    """
+    errors.expects(kill_after_acks >= 1,
+                   "run_crash_ingest_cycle: kill_after_acks=%s < 1",
+                   kill_after_acks)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "raft_tpu.testing.crash_child",
+           str(wal_dir), str(int(n_records)), str(int(d)),
+           str(int(seed)), str(float(flush_ms))]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            env=env)
+    watchdog = threading.Timer(timeout_s, proc.kill)
+    watchdog.daemon = True
+    watchdog.start()
+    acked: List[Tuple[int, int]] = []
+    try:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            parts = line.split()
+            if len(parts) != 3 or parts[0] != "ACK":
+                continue
+            acked.append((int(parts[1]), int(parts[2])))
+            if len(acked) >= kill_after_acks:
+                proc.kill()   # SIGKILL: no cleanup, no flush
+                break
+        proc.wait(timeout=timeout_s)
+    finally:
+        watchdog.cancel()
+        if proc.poll() is None:  # pragma: no cover - watchdog race
+            proc.kill()
+            proc.wait(timeout=10.0)
+    from raft_tpu.durability import wal as _wal
+    records, frontier = _wal.repair_wal(wal_dir, name="crash-cycle")
+    recovered: List[Tuple[int, int]] = []
+    for r in records:
+        if r.op == _wal.OP_UPSERT:
+            _vecs, ids = _wal.decode_upsert(r.payload)
+            for gid in ids:
+                recovered.append((int(r.lsn), int(gid)))
+    return {
+        "acked": acked,
+        "recovered": recovered,
+        "frontier": int(frontier),
+        "submitted": int(n_records),
+        "returncode": proc.returncode,
+    }

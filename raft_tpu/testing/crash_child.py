@@ -1,0 +1,56 @@
+"""Scripted ingest victim for the kill−9 chaos leg.
+
+Run as ``python -m raft_tpu.testing.crash_child <wal_dir> <n> <d>
+<seed> <flush_ms>`` (the parent is :func:`raft_tpu.testing.chaos.
+run_crash_ingest_cycle`).  Appends ``n`` seeded single-row upsert
+records through a real :class:`~raft_tpu.durability.wal.WalWriter`
+and prints ``ACK <lsn> <id>`` — flushed, one per line — STRICTLY
+after ``ack.wait()`` returned, i.e. after the record's fsync.  The
+parent SIGKILLs this process mid-loop, so an ack line on its stdout
+is a durability claim the recovered WAL must honour: that is the
+entire point of the script.  Record ids are ``100000 + k`` so the
+parent can map acks back to submissions without sharing state.
+
+Imports nothing from JAX at module scope and journals host-side only
+(the WAL path compiles nothing), so the child starts in well under a
+second even on a cold cache.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    if len(args) != 5:
+        print("usage: crash_child <wal_dir> <n> <d> <seed> <flush_ms>",
+              file=sys.stderr)
+        return 64
+    wal_dir = args[0]
+    n, d, seed = int(args[1]), int(args[2]), int(args[3])
+    flush_ms = float(args[4])
+
+    import numpy as np
+
+    from raft_tpu.durability import wal
+
+    rng = np.random.default_rng(seed)
+    writer = wal.WalWriter(wal_dir, flush_interval_s=flush_ms / 1e3,
+                           name="crash-child")
+    for k in range(n):
+        vec = rng.standard_normal((1, d)).astype(np.float32)
+        gid = 100000 + k
+        payload = wal.encode_upsert(vec, np.asarray([gid], np.int32))
+        ack = writer.append(wal.OP_UPSERT, payload, epoch=k)
+        if not ack.wait(30.0):
+            return 2   # fsync wedged: never claim durability
+        print(f"ACK {ack.lsn} {gid}", flush=True)
+    writer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

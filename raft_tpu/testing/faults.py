@@ -15,8 +15,10 @@ reproduces bit-for-bit. The injectors cover the serving failure model
   it (``load_index`` → ``CorruptIndexError``);
 * :func:`inject_partial_write` — a partial delta-checkpoint flush
   (torn-write truncation or a duplicated/stale block) at a chosen
-  member boundary — the mutation tier's mid-ingest crash model
-  (docs/mutation.md);
+  member boundary, or (``at_byte=``) a raw tear at an ARBITRARY byte
+  offset of any file — the mutation tier's mid-ingest crash model and
+  the WAL torn-tail fuzz's cutter (docs/mutation.md,
+  docs/robustness.md "Durability");
 * :func:`cancel_after` — arm a delayed cross-thread cancel against an
   in-flight ``Interruptible.synchronize``;
 * :func:`fail_rank` — mark shard(s) down on a
@@ -27,6 +29,7 @@ reproduces bit-for-bit. The injectors cover the serving failure model
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 import zipfile
@@ -249,6 +252,7 @@ def corrupt_bytes(path, *, field: Optional[str] = None, n_bytes: int = 1,
 
 def inject_partial_write(path, *, mode: str = "truncate",
                          boundary: Optional[int] = None,
+                         at_byte: Optional[int] = None,
                          seed: int = 0) -> str:
     """Model a PARTIAL flush of a delta-segment checkpoint
     (:func:`raft_tpu.spatial.ann.mutation.save_delta_checkpoint`) — the
@@ -270,12 +274,33 @@ def inject_partial_write(path, *, mode: str = "truncate",
     (default: the middle member, deterministic from ``seed`` when the
     archive has one candidate pair). Returns the damaged member name
     (without ``.npy``).
+
+    ``at_byte`` (``mode="truncate"`` only) tears the RAW file at an
+    ARBITRARY byte offset instead of a member boundary — no container
+    parsing at all, so it works on any format (the WAL torn-tail fuzz
+    cuts a segment log at EVERY offset, docs/robustness.md
+    "Durability"). Returns the file's basename in that case.
     """
     errors.expects(
         mode in ("truncate", "duplicate"),
         "inject_partial_write: mode=%r not in ('truncate', 'duplicate')",
         mode,
     )
+    if at_byte is not None:
+        errors.expects(
+            mode == "truncate",
+            "inject_partial_write: at_byte requires mode='truncate', "
+            "got %r", mode,
+        )
+        size = os.path.getsize(path)
+        errors.expects(
+            0 <= at_byte <= size,
+            "inject_partial_write: at_byte=%d outside [0, %d]",
+            at_byte, size,
+        )
+        with open(path, "rb+") as f:
+            f.truncate(int(at_byte))
+        return os.path.basename(path)
     with zipfile.ZipFile(path) as z:
         infos = z.infolist()
         payload = {i.filename: z.read(i.filename) for i in infos}

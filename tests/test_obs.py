@@ -759,6 +759,55 @@ class TestListLoadMetrics:
         assert "serving_list_rows_total" in names
 
 
+# ------------------------------------- mutation journal telemetry
+class TestMutationJournalTelemetry:
+    def test_journal_overflow_counts_and_flight_marks(self):
+        """ISSUE 20 satellite: an epoch-journal overflow silently
+        downgrades stale readers to "refresh everything" — it must be
+        attributable: mutation_journal_compacted_total counts the
+        dropped entries and each overflow flight-marks the new floor.
+        Driven through _journal_note directly (the real write path
+        calls it per mutation) so the file stays host-side cheap."""
+        import types
+
+        from raft_tpu.spatial.ann import mutation as mut_mod
+
+        fl = FlightRecorder()
+        m = types.SimpleNamespace(
+            _epoch_journal=[], _journal_floor=0, epoch=0,
+            name="journal-tel", flight=fl,
+        )
+        counter = mut_mod._mseries("journal-tel")["journal_compacted"]
+        before = counter.value
+        overflow = 6
+        for e in range(mut_mod._EPOCH_JOURNAL_CAP + overflow):
+            m.epoch = e + 1
+            mut_mod._journal_note(m, [e % 4])
+        assert counter.value == before + overflow
+        assert len(m._epoch_journal) == mut_mod._EPOCH_JOURNAL_CAP
+        evs = fl.events(event="mutation_journal_compacted")
+        assert len(evs) == overflow
+        floors = [e["floor"] for e in evs]
+        assert floors == sorted(floors) and floors[-1] == overflow
+        assert all(e["index"] == "journal-tel" and e["dropped"] == 1
+                   for e in evs)
+        # below the floor the journal answers None = full refresh
+        assert mut_mod.lists_changed_since(m, 0) is None
+
+    def test_no_flight_recorder_is_fine(self):
+        import types
+
+        from raft_tpu.spatial.ann import mutation as mut_mod
+
+        m = types.SimpleNamespace(
+            _epoch_journal=[], _journal_floor=0, epoch=0,
+            name="journal-tel2", flight=None,
+        )
+        for e in range(mut_mod._EPOCH_JOURNAL_CAP + 2):
+            m.epoch = e + 1
+            mut_mod._journal_note(m, None)
+
+
 # -------------------------------------------- metric-catalog parity
 class TestMetricCatalogParity:
     def test_every_emitted_series_has_a_catalog_row(self):

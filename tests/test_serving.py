@@ -382,7 +382,8 @@ def test_serving_latency_rows_tiny_config():
         cold_tier=False,  # (tests/test_result_cache.py); the cold_tier
         self_heal=False,  # row's smoke lives in tests/test_tier.py, the
         graph=False,      # self_heal row's in tests/test_chaos.py, the
-    )                     # graph_ann row's below
+        durable=False,    # graph_ann + durable_ingest rows' below
+    )
     assert out["unit"] == "ms"
     assert [r["nq"] for r in out["rows"]] == [1, 4]
     for r in out["rows"]:
@@ -417,6 +418,39 @@ def test_graph_ann_row_tiny_config():
     assert ("p50_ms" in row) or ("error" in row)
     assert "ivf_recall_at_10" in row and "recall_at_10" in row
     assert row["recall_at_10"] >= row["ivf_recall_at_10"] - 0.01
+
+
+def test_durable_ingest_row_tiny_config():
+    """The durable-WAL ingest row on a tiny CPU config
+    (docs/robustness.md "Durability"): both arms must stamp acked QPS,
+    the ratio must be a positive quotient of them, the fsync sweep must
+    carry one point per swept interval with real fsyncs counted, and
+    the WAL throughput stamp must be positive (the ratio's 0.8
+    acceptance is hardware territory — the CPU drive proves the
+    measurement, not the win)."""
+    from bench.bench_serving import durable_ingest_row
+
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((4096, 8)).astype(np.float32)
+    q = x[::31][:32] + 0.05 * rng.standard_normal((32, 8)).astype(
+        np.float32
+    )
+    idx = ivf_flat_build(x, IVFFlatParams(n_lists=8, kmeans_n_iters=3,
+                                          seed=4))
+    row = durable_ingest_row(idx, q, ingest_batch=16, n_batches=6,
+                             delta_cap=32,
+                             fsync_intervals_ms=(0.0, 1.0))
+    assert row["scenario"] == "durable_ingest"
+    assert row["engine"] == "ivf_flat"
+    assert row["durable_qps"] > 0 and row["nondurable_qps"] > 0
+    assert row["durability_ratio"] == pytest.approx(
+        row["durable_qps"] / row["nondurable_qps"], rel=1e-2
+    )
+    assert row["fsync_interval_ms"] in (0.0, 1.0)
+    assert row["fsync_p50_ms"] >= 0.0 and row["wal_mb_per_s"] > 0
+    assert len(row["fsync_sweep"]) == 2
+    for pt in row["fsync_sweep"]:
+        assert pt["n_fsyncs"] >= 1          # every ack rode an fsync
 
 
 def test_serving_resilience_rows_tiny_config():
@@ -1552,5 +1586,80 @@ def test_round19_bench_line_parses_with_graph_ann():
         assert key in benchtop._PRINT_KEYS
         assert key not in benchtop._TRIM_ORDER
     for key in ("ivf_qcap", "ivf_spread"):
+        assert key in benchtop._PRINT_KEYS
+        assert key in benchtop._TRIM_ORDER
+
+
+def test_round20_bench_line_parses_with_durable_ingest():
+    """ISSUE 20 satellite (the _fit_line parse/cap test extended,
+    following the r05-r19 pattern): the round-20 artifact shape — every
+    prior row PLUS the ``durable_ingest`` row (fsync-durable acked QPS
+    vs the non-durable apply, docs/robustness.md "Durability") — must
+    print as a line that json.loads-round-trips under the 1800-char
+    driver cap, with the acceptance stamps (``durable_qps``,
+    ``nondurable_qps``, ``durability_ratio``) untrimmable."""
+    import importlib.util
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "benchtop_r20", os.path.join(root, "bench.py")
+    )
+    benchtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(benchtop)
+
+    extras = [
+        {"metric": f"extra_{i}", "value": 10000.0 + i, "unit": "QPS",
+         "spread": 0.05, "repeats": 7, "escalations": 1,
+         "adc_engine": "pallas", "recall_at_10": 0.95,
+         "build_s": 150.0, "build_warm_s": 2.0, "qcap8_qps": 1.2e5,
+         "measured_chip_qps": 1.1e4, "sharded_e2e_qps": 1.05e4,
+         "probe_recall_vs_flat": 0.997, "probe_flop_ratio": 5.2,
+         "brute_force_same_shape_qps": 1.5e5, "vs_prev": 1.01}
+        for i in range(8)
+    ] + [
+        # the round-19 graph-ANN row, unchanged
+        {"metric": "graph_ann_500000x96", "unit": "ms",
+         "scenario": "graph_ann", "engine": "graph", "nq": 1,
+         "degree": 16, "beam": 32, "iters": 23,
+         "p50_ms": 0.41, "recall_at_10": 0.961, "spread": 0.04,
+         "repeats": 5, "ivf_p50_ms": 1.38, "ivf_recall_at_10": 0.958,
+         "ivf_qcap": 8, "ivf_spread": 0.05, "vs_prev": 1.0},
+        # the round-20 durable-ingest row under test
+        {"metric": "durable_ingest_500000x96", "unit": "QPS",
+         "scenario": "durable_ingest", "engine": "ivf_flat",
+         "durable_qps": 38500.0, "nondurable_qps": 41200.0,
+         "durability_ratio": 0.934, "fsync_interval_ms": 0.0,
+         "fsync_p50_ms": 0.071, "wal_mb_per_s": 18.4,
+         "spread": 0.03, "repeats": 5, "vs_prev": 1.0},
+    ]
+    doc = {
+        "metric": "pairwise_l2_expanded_8192x8192x512_f32",
+        "value": 101000.5, "unit": "GFLOPS", "spread": 0.01,
+        "repeats": 3, "f32_highest_gflops": 55000.2,
+        "program_audit_ms": 34193.2,
+        "vs_baseline": 10.1, "vs_prev": 1.0,
+        "extras": extras,
+    }
+    line = benchtop._fit_line(doc)
+    parsed = json.loads(line)               # round-trips
+    assert len(line) <= 1800
+    assert isinstance(parsed, dict)
+    # on a roomy line the row prints whole, acceptance stamps included
+    small = benchtop._fit_line({
+        "metric": "durable_ingest_500000x96", "unit": "QPS",
+        "durable_qps": 38500.0, "nondurable_qps": 41200.0,
+        "durability_ratio": 0.934, "fsync_interval_ms": 0.0,
+        "fsync_p50_ms": 0.071, "wal_mb_per_s": 18.4, "extras": [],
+    })
+    small_parsed = json.loads(small)
+    assert small_parsed["durable_qps"] == 38500.0
+    assert small_parsed["nondurable_qps"] == 41200.0
+    assert small_parsed["durability_ratio"] == 0.934
+    # the acceptance evidence is untrimmable; the secondaries trim
+    for key in ("durable_qps", "nondurable_qps", "durability_ratio"):
+        assert key in benchtop._PRINT_KEYS
+        assert key not in benchtop._TRIM_ORDER
+    for key in ("fsync_interval_ms", "fsync_p50_ms", "wal_mb_per_s"):
         assert key in benchtop._PRINT_KEYS
         assert key in benchtop._TRIM_ORDER

@@ -302,6 +302,45 @@ class C:
         assert names(fs) == ["blocking-call-under-lock"]
         assert "stays held" in fs[0].message
 
+    def test_fsync_under_lock_flagged_outside_clean(self):
+        """ISSUE 20 satellite: durable IO is a blocking call — the WAL
+        group-commit contract is fsync OUTSIDE the lock, publish the
+        durable LSN under it."""
+        src = """import os
+import threading
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._durable = 0
+    def bad(self, fd):
+        with self._lock:
+            os.fsync(fd)
+    def good(self, fd, lsn):
+        os.fsync(fd)
+        with self._lock:
+            self._durable = lsn
+"""
+        fs = tlint(src)
+        assert names(fs) == ["blocking-call-under-lock"]
+        assert "os.fsync" in fs[0].message
+        assert "outside the lock" in fs[0].message
+
+    def test_flush_under_lock_flagged(self):
+        src = """import threading
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._f = open("/dev/null", "wb")
+    def bad(self):
+        with self._lock:
+            self._f.flush()
+    def fine(self):
+        self._f.flush()
+"""
+        fs = tlint(src)
+        assert names(fs) == ["blocking-call-under-lock"]
+        assert "parks behind" in fs[0].message
+
 
 # ------------------------------------------------ static: sleep
 class TestSleepUnderLock:
