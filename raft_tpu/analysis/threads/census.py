@@ -36,7 +36,11 @@ every class it records:
   the instrument leaf lock;
 * **thread attributes** — ``self.X = threading.Thread(...)``, so the
   blocking-call rule flags ``.join()`` only on receivers that are
-  actually threads (never ``",".join``).
+  actually threads (never ``",".join``);
+* **file attributes** — ``self.X = open(...)`` / ``os.fdopen(...)``,
+  so the blocking-call rule flags ``.flush()`` only on receivers that
+  are actually file handles (a buffer/queue/logger ``flush()`` under a
+  lock parks behind nothing).
 
 Deliberately lexical, like :mod:`raft_tpu.analysis.facts`: dynamic
 dispatch, locks passed between objects, and module-global mutation are
@@ -58,6 +62,7 @@ LOCK_TAILS = frozenset({"Lock", "RLock", "make_lock"})
 COND_TAILS = frozenset({"Condition", "make_condition"})
 EVENT_TAILS = frozenset({"Event"})
 THREAD_TAILS = frozenset({"Thread"})
+FILE_TAILS = frozenset({"open", "fdopen"})
 
 # container mutators that count as WRITES to the attribute holding the
 # container (the census cares about mutation, not rebinding)
@@ -148,6 +153,7 @@ class ClassCensus:
         self.locks: Dict[str, str] = {}
         self.event_attrs: Set[str] = set()
         self.thread_attrs: Set[str] = set()
+        self.file_attrs: Set[str] = set()
         self.instrument_attrs: Set[str] = set()
         self.attr_classes: Dict[str, str] = {}
         self.init_attrs: Set[str] = set()
@@ -185,9 +191,10 @@ class ClassCensus:
         return self.name
 
     def _scan_thread_attrs(self) -> None:
-        """``self.X = threading.Thread(...)`` in ANY method marks a
-        thread attr (the compactor assigns its worker in ``submit``,
-        not ``__init__``)."""
+        """``self.X = threading.Thread(...)`` / ``open(...)`` in ANY
+        method marks a thread/file attr (the compactor assigns its
+        worker in ``submit``, not ``__init__``; the WAL writer rebinds
+        its segment handle on rotation)."""
         for fn in self.methods.values():
             for stmt in ast.walk(fn):
                 if not isinstance(stmt, ast.Assign) or not isinstance(
@@ -195,12 +202,16 @@ class ClassCensus:
                     continue
                 callee = self.facts.callee(stmt.value)
                 tail = callee.rsplit(".", 1)[-1] if callee else None
-                if tail not in THREAD_TAILS:
+                if tail in THREAD_TAILS:
+                    into = self.thread_attrs
+                elif tail in FILE_TAILS:
+                    into = self.file_attrs
+                else:
                     continue
                 for tgt in stmt.targets:
                     attr = _self_attr(tgt)
                     if attr is not None:
-                        self.thread_attrs.add(attr)
+                        into.add(attr)
 
     # -- __init__ scan --------------------------------------------------------
 
@@ -495,6 +506,7 @@ class _ToplevelCensus(ClassCensus):
         self.locks = {}
         self.event_attrs = set()
         self.thread_attrs = set()
+        self.file_attrs = set()
         self.instrument_attrs = set()
         self.attr_classes = {}
         self.init_attrs = set()

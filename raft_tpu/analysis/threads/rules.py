@@ -17,9 +17,11 @@ the tier-1 ``style`` stage is unchanged):
   ``Event.wait``, ``Future.result``, and ``Thread.join`` while holding
   a lock — each parks a thread that other threads may need the held
   lock to wake; plus the durable-IO calls — ``os.fsync`` /
-  ``os.fdatasync`` / ``.flush()`` — which park the holder behind the
-  DISK (the WAL group-commit contract: acks are taken under the lock,
-  the fsync batch runs outside it, docs/robustness.md "Durability");
+  ``os.fdatasync``, and ``.flush()`` on a receiver the census knows is
+  a FILE handle (any object may grow a cheap ``flush()``) — which park
+  the holder behind the DISK (the WAL group-commit contract: acks are
+  taken under the lock, the fsync batch runs outside it,
+  docs/robustness.md "Durability");
 * ``sleep-under-lock`` — ``time.sleep`` while holding a lock
   serializes every contender behind a timer.
 
@@ -111,13 +113,14 @@ class BlockingCallUnderLock(Rule):
     name = "blocking-call-under-lock"
     description = (
         "Condition.wait on a foreign lock, Event.wait, Future.result, "
-        "Thread.join, or durable IO (os.fsync/os.fdatasync/.flush) "
-        "while holding a lock"
+        "Thread.join, or durable IO (os.fsync/os.fdatasync, file "
+        ".flush) while holding a lock"
     )
 
     def check(self, ctx) -> Iterator:
         for census in _censuses(ctx):
             aliases = self._thread_aliases(census)
+            file_aliases = self._file_aliases(ctx, census)
             for node, method in census.method_of.items():
                 if not isinstance(node, ast.Call):
                     continue
@@ -160,12 +163,21 @@ class BlockingCallUnderLock(Rule):
                             "durable LSN under it",
                         )
                 elif tail == "flush":
-                    yield ctx.finding(
-                        self.name, node,
-                        f".flush() while holding {self._chain(held)} "
-                        f"in {method}() — the holder parks behind "
-                        "the disk",
-                    )
+                    # file receivers ONLY (mirroring the fsync callee
+                    # check): any object may grow a cheap .flush() —
+                    # buffers, queues, loggers — and flagging those
+                    # would fail the gate on non-IO code
+                    is_file = recv_attr in census.file_attrs
+                    if not is_file and isinstance(f.value, ast.Name):
+                        is_file = f.value.id in file_aliases.get(
+                            method, set())
+                    if is_file:
+                        yield ctx.finding(
+                            self.name, node,
+                            f".flush() while holding "
+                            f"{self._chain(held)} in {method}() — "
+                            "the holder parks behind the disk",
+                        )
 
     def _check_wait(self, ctx, census, node, method, recv_attr, held):
         if recv_attr in census.event_attrs:
@@ -196,6 +208,25 @@ class BlockingCallUnderLock(Rule):
     @staticmethod
     def _chain(held) -> str:
         return " -> ".join(k.split(":", 1)[1] for k in held)
+
+    @staticmethod
+    def _file_aliases(ctx, census) -> Dict[str, Set[str]]:
+        """Per method: local names bound to a file handle
+        (``f = open(...)`` / ``f = self._file``)."""
+        out: Dict[str, Set[str]] = {}
+        for node, method in census.method_of.items():
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            is_file = _self_attr(node.value) in census.file_attrs
+            if not is_file and isinstance(node.value, ast.Call):
+                callee = ctx.facts.callee(node.value)
+                tail = callee.rsplit(".", 1)[-1] if callee else None
+                is_file = tail in ("open", "fdopen")
+            if is_file:
+                out.setdefault(method, set()).add(node.targets[0].id)
+        return out
 
     @staticmethod
     def _thread_aliases(census) -> Dict[str, Set[str]]:

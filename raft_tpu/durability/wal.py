@@ -369,8 +369,10 @@ class WalWriter:
     returned) without a real disk.
 
     Reopening a directory never appends into an existing segment: the
-    constructor scans for the frontier and starts a fresh segment at
-    ``frontier + 1``, leaving any torn tail for :func:`repair_wal`.
+    constructor runs :func:`repair_wal` first (appending past an
+    unrepaired tear would put durably-acked frames into segments a
+    later repair classifies as past-tear and deletes), then starts a
+    fresh segment at the repaired ``frontier + 1``.
     A flusher IO failure latches: every later ``append``/``wait``
     re-raises it (durability can not be silently downgraded).
     """
@@ -400,7 +402,11 @@ class WalWriter:
         self._flight = flight
         self._series = series(name)
         os.makedirs(path, exist_ok=True)
-        frontier = wal_frontier(path)
+        # Repair BEFORE computing the frontier: the scan stops at a
+        # tear, so appending at scan-frontier + 1 would land acked
+        # frames in a segment that sorts after the damaged one — a
+        # later repair_wal would call it past-tear and delete it.
+        _, frontier = repair_wal(path, name=name, flight=flight)
         self._lock = lockcheck.make_lock("WalWriter._lock")
         self._cv = lockcheck.make_condition(self._lock)
         self._buf: list = []
@@ -415,6 +421,18 @@ class WalWriter:
         # touches it after construction, so it needs no lock at all
         self._active_seg = os.path.join(
             path, _segment_name(frontier + 1))
+        if os.path.exists(self._active_seg):
+            # post-repair this can only be a record-free shell (a
+            # header-only segment left by a no-append open); records
+            # here mean LSNs the scan missed — refuse to truncate them
+            if scan_segment(self._active_seg)[0]:
+                raise errors.CorruptIndexError(
+                    f"WalWriter({name}): segment "
+                    f"{os.path.basename(self._active_seg)} holds "
+                    f"records although the repaired frontier is "
+                    f"{frontier}; refusing to overwrite it",
+                    field="__frontier__",
+                )
         self._file = open(self._active_seg, "wb")
         self._file.write(_FILE_HEADER)
         self._file.flush()
@@ -652,7 +670,14 @@ class DurableIngest:
     is safe: recovery (:func:`recover_mutable`) rebuilds exactly the
     durable prefix, which covers every acked batch and never a torn
     one. :meth:`checkpoint` stamps the applied LSN into the delta
-    checkpoint and prunes the WAL behind it."""
+    checkpoint and prunes the WAL behind it.
+
+    A durability failure (the writer latched an IO error, or an ack
+    timed out) latches HERE too: the in-memory state is now ahead of
+    the durable log, so :attr:`mindex` and every later op raise —
+    serving it would expose rows that were never durable and vanish on
+    restart. Discard the front end and re-run
+    :func:`recover_mutable`."""
 
     def __init__(self, mindex, wal: WalWriter, *,
                  applied_lsn: typing.Optional[int] = None):
@@ -661,11 +686,36 @@ class DurableIngest:
         self._wal = wal
         self._applied_lsn = int(
             wal.durable_lsn if applied_lsn is None else applied_lsn)
+        self._failed: typing.Optional[BaseException] = None
+
+    def _require_live(self) -> None:
+        # under self._lock
+        if self._failed is not None:
+            raise errors.CorruptIndexError(
+                "DurableIngest: a durability ack failed "
+                f"({self._failed!r}); the in-memory state is ahead of "
+                "the durable log — discard this front end and re-run "
+                "recover_mutable", field="__wal__",
+            ) from self._failed
+
+    def _await_durable(self, ack: WalAck):
+        # outside self._lock: parks behind the disk
+        try:
+            ok = ack.wait()
+            errors.expects(
+                ok, "DurableIngest: ack for lsn %d timed out", ack.lsn)
+        except BaseException as e:
+            with self._lock:
+                if self._failed is None:
+                    self._failed = e
+            raise
 
     @property
     def mindex(self):
-        """The current (search-servable) index state."""
+        """The current (search-servable) index state; raises once a
+        durability ack has failed (the state is no longer durable)."""
         with self._lock:
+            self._require_live()
             return self._mindex
 
     @property
@@ -685,13 +735,12 @@ class DurableIngest:
         i = np.asarray(ids, np.int32)
         payload = encode_upsert(v, i)
         with self._lock:
+            self._require_live()
             ack = self._wal.append(
                 OP_UPSERT, payload, epoch=self._mindex.epoch)
             self._mindex, accepted = mutation.upsert(self._mindex, v, i)
             self._applied_lsn = ack.lsn
-        ok = ack.wait()
-        errors.expects(
-            ok, "DurableIngest: ack for lsn %d timed out", ack.lsn)
+        self._await_durable(ack)
         return accepted
 
     def delete(self, ids):
@@ -700,13 +749,12 @@ class DurableIngest:
         i = np.asarray(ids, np.int32)
         payload = encode_delete(i)
         with self._lock:
+            self._require_live()
             ack = self._wal.append(
                 OP_DELETE, payload, epoch=self._mindex.epoch)
             self._mindex, found = mutation.delete(self._mindex, i)
             self._applied_lsn = ack.lsn
-        ok = ack.wait()
-        errors.expects(
-            ok, "DurableIngest: ack for lsn %d timed out", ack.lsn)
+        self._await_durable(ack)
         return found
 
     def checkpoint(self, path, *, prune: bool = True) -> int:
@@ -720,6 +768,7 @@ class DurableIngest:
         would have cleared it and the overwrite would lose those
         lists)."""
         with self._lock:
+            self._require_live()
             m = self._mindex
             lsn = self._applied_lsn
             w = self._wal

@@ -341,6 +341,37 @@ class W:
         assert names(fs) == ["blocking-call-under-lock"]
         assert "parks behind" in fs[0].message
 
+    def test_non_file_flush_under_lock_clean(self):
+        """REVIEW fix: only FILE receivers trip the .flush() check — a
+        buffer/queue/logger flush under a lock parks behind nothing
+        and must not fail the gate."""
+        src = """import threading
+class Batcher:
+    def __init__(self, sink):
+        self._lock = threading.Lock()
+        self._sink = sink
+    def drain(self):
+        with self._lock:
+            self._sink.flush()
+"""
+        assert tlint(src) == []
+
+    def test_file_alias_flush_under_lock_flagged(self):
+        """A local bound to open() (or to a file attr) is still a file
+        receiver for the .flush() check."""
+        src = """import threading
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def bad(self, path):
+        f = open(path, "wb")
+        with self._lock:
+            f.flush()
+"""
+        fs = tlint(src)
+        assert names(fs) == ["blocking-call-under-lock"]
+        assert "parks behind" in fs[0].message
+
 
 # ------------------------------------------------ static: sleep
 class TestSleepUnderLock:

@@ -16,8 +16,10 @@ Contracts under test (docs/robustness.md "Durability"):
   returned (injectable fsync/clock prove the ordering without a
   disk); a flusher IO failure latches and fails later appends loudly;
 * rotation + retention: prune removes only segments fully behind the
-  checkpoint watermark, never the active one; reopen starts a FRESH
-  segment at frontier+1;
+  checkpoint watermark, never the active one; reopen REPAIRS any torn
+  tail first and starts a FRESH segment at the repaired frontier+1
+  (never appends past an unrepaired tear, never truncates a segment
+  holding records);
 * recovery = checkpoint + WAL tail replay is bit-identical to the
   live state, including under a live-ingest vs checkpoint race;
 * MNMG: per-rank WALs, quorum acks (a rank with a dead WAL stops
@@ -427,6 +429,47 @@ class TestRotationRetention:
         assert len(wal.segment_paths(d)) == n_segs + 1
         assert wal.wal_frontier(d) == 5
 
+    def test_reopen_over_torn_tail_repairs_first(self, tmp_path):
+        """REVIEW fix: a writer opened over a torn directory repairs
+        it BEFORE computing the frontier — appending at an unrepaired
+        scan-frontier puts acked frames past the tear, where a later
+        repair_wal would classify them past-tear and DELETE them."""
+        d = str(tmp_path / "w")
+        _write_log(d, n=3, d=4)
+        seg = wal.segment_paths(d)[0]
+        faults.inject_partial_write(
+            seg, at_byte=os.path.getsize(seg) - 2)
+        w = wal.WalWriter(d, flush_interval_s=0.0005)
+        assert w.durable_lsn == 2             # frame 3 was torn away
+        ack = w.append(wal.OP_DELETE,
+                       wal.encode_delete(np.array([9], np.int32)))
+        assert ack.wait(10.0) and ack.lsn == 3
+        w.close()
+        # the acked frame SURVIVES a later repair: it is a clean tail,
+        # not past-tear garbage
+        records, frontier = wal.repair_wal(d, name="reopen-tear")
+        assert frontier == 3
+        assert [r.lsn for r in records] == [1, 2, 3]
+        assert records[-1].op == wal.OP_DELETE
+
+    def test_reopen_refuses_segment_holding_records(self, tmp_path):
+        """REVIEW fix: the constructor never opens an existing segment
+        with records in 'wb' mode — a colliding segment whose LSNs the
+        scan deduped away (a copied directory) raises instead of being
+        silently truncated."""
+        d = str(tmp_path / "w")
+        _write_log(d, n=3, d=4)
+        seg = wal.segment_paths(d)[0]
+        # duplicated segment named frontier+1: its records dedupe to
+        # nothing, so a naive reopen would truncate it
+        shutil.copyfile(seg, os.path.join(
+            d, "wal-00000000000000000004.log"))
+        with pytest.raises(errors.CorruptIndexError):
+            wal.WalWriter(d, flush_interval_s=0.0005)
+        # nothing was scribbled on: the log still reads back whole
+        records, frontier = wal.read_records(d)
+        assert frontier == 3 and len(records) == 3
+
 
 # ------------------------------------------------- single-chip recovery
 class TestDurableIngestRecovery:
@@ -516,6 +559,42 @@ class TestDurableIngestRecovery:
                                   np.asarray(getattr(live.delta, f))), f
         assert np.array_equal(np.asarray(rec.row_mask),
                               np.asarray(live.row_mask))
+
+    def test_durability_failure_latches_front_end(self, tmp_path,
+                                                  flat_index, dataset):
+        """REVIEW fix: once an ack fails, the in-memory state is ahead
+        of the durable log — the front end must stop serving it
+        instead of exposing rows that vanish on restart."""
+        _, q = dataset
+        boom = threading.Event()
+
+        def failing_fsync(fd):
+            if boom.is_set():
+                raise OSError(5, "injected EIO")
+            os.fsync(fd)
+
+        d = str(tmp_path / "w")
+        w = wal.WalWriter(d, flush_interval_s=0.0005,
+                          fsync=failing_fsync)
+        ing = wal.DurableIngest(wrap_mutable(flat_index, delta_cap=8),
+                                w)
+        ids = np.arange(9600, 9604, dtype=np.int32)
+        assert ing.upsert(q[:4], ids).all()
+        boom.set()
+        with pytest.raises(OSError):          # the latched EIO
+            ing.delete(ids[:2])
+        # the applied-but-never-durable state is no longer served
+        with pytest.raises(errors.CorruptIndexError):
+            _ = ing.mindex
+        with pytest.raises(errors.CorruptIndexError):
+            ing.upsert(q[:1], ids[:1])
+        with pytest.raises(errors.CorruptIndexError):
+            ing.checkpoint(str(tmp_path / "c.ckpt"))
+        ing.close()
+        # the acked frame is still recoverable from the log
+        records, frontier = wal.repair_wal(d, name="latch")
+        assert frontier >= 1 and records[0].lsn == 1
+        assert records[0].op == wal.OP_UPSERT
 
     def test_wal_path_compiles_nothing(self, tmp_path, flat_index,
                                        dataset):
